@@ -1,0 +1,83 @@
+"""Benchmark: batched rule evaluation throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline = the BASELINE.json north star (1M req/s full-CRS on one v5e-1),
+so vs_baseline is value / 1e6. Extra keys carry the e2e (incl. Python
+extraction) number and batch latency percentiles.
+
+Config via env:
+  BENCH_RULES   — number of synthetic CRS-style rules (default 200)
+  BENCH_BATCH   — requests per batch (default 1024)
+  BENCH_ITERS   — timed iterations (default 30)
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def main() -> None:
+    n_rules = int(os.environ.get("BENCH_RULES", "200"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+
+    import jax
+
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs, synthetic_requests
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf
+
+    engine = WafEngine(synthetic_crs(n_rules))
+    requests = synthetic_requests(batch, attack_ratio=0.1, seed=1)
+
+    # --- device-only throughput (pre-tensorized, steady-state serving) ----
+    extractions = [engine.extractor.extract(r) for r in requests]
+    t_extract0 = time.perf_counter()
+    tensors = engine._tensorize(extractions)
+    tensorize_s = time.perf_counter() - t_extract0
+
+    out = eval_waf(engine.model, *tensors)  # compile + warm
+    jax.block_until_ready(out["interrupted"])
+
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = eval_waf(engine.model, *tensors)
+        jax.block_until_ready(out["interrupted"])
+        lat.append(time.perf_counter() - t0)
+    device_rps = batch / statistics.median(lat)
+    p99_ms = sorted(lat)[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+
+    # --- end-to-end throughput (extraction + tensorize + eval) ------------
+    t0 = time.perf_counter()
+    e2e_iters = max(3, iters // 5)
+    for _ in range(e2e_iters):
+        engine.evaluate(requests)
+    e2e_rps = batch * e2e_iters / (time.perf_counter() - t0)
+
+    blocked = int(jax.numpy.sum(out["interrupted"]))
+    result = {
+        "metric": "crs_rule_eval_req_per_s_per_chip",
+        "value": round(device_rps, 1),
+        "unit": "req/s",
+        "vs_baseline": round(device_rps / 1_000_000, 4),
+        "e2e_req_per_s": round(e2e_rps, 1),
+        "p99_batch_ms": round(p99_ms, 2),
+        "batch": batch,
+        "rules_requested": n_rules,
+        "rules_compiled": engine.compiled.n_rules,
+        "groups": engine.compiled.n_groups,
+        "blocked_in_batch": blocked,
+        "tensorize_s": round(tensorize_s, 3),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""coraza_kubernetes_operator_tpu — a TPU-native WAF framework.
+
+A from-scratch rebuild of the capabilities of
+``shaneutt/coraza-kubernetes-operator`` (the Go control plane that compiles,
+caches and serves Seclang rulesets to a WAF data plane — see reference
+``cmd/main.go``, ``internal/controller/``, ``internal/rulesets/cache/``)
+PLUS a first-party TPU batch data plane replacing the external
+``coraza-proxy-wasm`` module: Seclang rules are lowered to vectorized
+multi-pattern/NFA tables and evaluated over batched HTTP requests with
+JAX/Pallas on TPU.
+
+Layering (bottom-up):
+
+- ``seclang``      — Seclang/ModSecurity directive parser (the validation role
+                     of ``coraza.NewWAF`` in reference
+                     ``internal/controller/ruleset_controller.go:158-171``).
+- ``compiler``     — lowers parsed rules to device tables (shift-and literal
+                     tables, Glushkov bitmask NFAs, transform pipelines,
+                     action/phase metadata).
+- ``ops``          — JAX/Pallas kernels: byte transforms, multi-pattern scan,
+                     blockwise NFA step, verdict reduction.
+- ``models``       — compiled matcher model families (pytrees + apply fns).
+- ``engine``       — the batch WAF engine: request tensorization, jitted
+                     evaluation, the ``tpu-engine`` sidecar with cache-poll
+                     hot reload.
+- ``parallel``     — ``jax.sharding`` mesh utilities: data-parallel batch
+                     sharding and rule-parallel table sharding.
+- ``cache``        — versioned ruleset cache + HTTP server, wire-compatible
+                     with reference ``internal/rulesets/cache/server.go``.
+- ``controlplane`` — Engine/RuleSet API types, validation, reconcilers,
+                     condition state machine, events (reference
+                     ``api/v1alpha1/`` + ``internal/controller/``).
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "waf.k8s.coraza.io"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"

@@ -1,0 +1,235 @@
+"""Position NFA → byte-class-compressed DFA tables.
+
+The device-side matcher (``ops/dfa.py``) is a ``lax.scan`` over input bytes
+doing two gathers per step: ``cls = classmap[byte]`` then
+``state, hit = trans[state, cls], emit[state, cls]``. This module builds those
+tables by subset construction over (position set, previous-byte context),
+where the previous-byte context (exists / is-word / is-newline) is exactly
+what's needed to evaluate assertion gaps, so ``\\b``/anchors are exact.
+
+Byte-class compression is the classic lexer-table trick: bytes with identical
+behavior across every position class share a column, typically compressing
+256 → ≲64 columns, an ~8x HBM saving across a full CRS ruleset.
+
+This replaces (TPU-shaped) what the reference outsources to the RE2 engine
+inside coraza-proxy-wasm (see ``hack/generate_coreruleset_configmaps.py:24-27``
+for the RE2 constraint the corpus already obeys).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .re_parser import RAlt, RCat, RChar, case_fold, parse_regex, WORD
+from .re_nfa import (
+    FALSE_DNF,
+    PositionNFA,
+    build_position_nfa,
+    eval_conj,
+)
+
+
+class DFAError(ValueError):
+    """Raised when a pattern cannot be compiled to bounded DFA tables."""
+
+
+# Previous-byte context: (exists, is_word, is_newline)
+_PREV_NONE = (False, False, False)
+
+
+def _prev_ctx_of(byte: int) -> tuple[bool, bool, bool]:
+    return (True, bool(WORD >> byte & 1), byte == 0x0A)
+
+
+def _eval_dnf_ctx(dnf, prev_ctx: tuple[bool, bool, bool], nxt: int | None) -> bool:
+    """Evaluate a DNF where the previous byte is abstracted to its context
+    bits. Assertions only inspect exists/is-word/is-newline of the previous
+    byte, so any representative byte with matching bits is equivalent."""
+    exists, is_word, is_nl = prev_ctx
+    if not exists:
+        prev = None
+    elif is_nl:
+        prev = 0x0A
+    elif is_word:
+        prev = ord("a")
+    else:
+        prev = ord(" ")
+    return any(eval_conj(conj, prev, nxt) for conj in dnf)
+
+
+@dataclass
+class DFA:
+    """Compiled scanner tables for one pattern.
+
+    ``trans[s, c]`` — next state; ``emit[s, c]`` — a match completed when
+    consuming a byte of class ``c`` in state ``s``; ``match_end[s]`` — a match
+    completes at end-of-input in state ``s``; ``classmap[b]`` — byte → class.
+    State 0 is initial. ``always_match`` short-circuits patterns that match
+    the empty string unconditionally.
+    """
+
+    trans: np.ndarray  # [S, C] int32
+    emit: np.ndarray  # [S, C] bool
+    match_end: np.ndarray  # [S] bool
+    classmap: np.ndarray  # [256] int32
+    always_match: bool
+
+    @property
+    def n_states(self) -> int:
+        return int(self.trans.shape[0])
+
+    @property
+    def n_classes(self) -> int:
+        return int(self.trans.shape[1])
+
+    def search(self, data: bytes) -> bool:
+        """Reference scalar scan — the oracle for kernel differential tests."""
+        if self.always_match:
+            return True
+        s = 0
+        for b in data:
+            c = self.classmap[b]
+            if self.emit[s, c]:
+                return True
+            s = self.trans[s, c]
+        return bool(self.match_end[s])
+
+
+def _byte_classes(nfa: PositionNFA) -> tuple[np.ndarray, list[int]]:
+    """Partition bytes into equivalence classes by (position-class membership
+    vector, word-ness, newline-ness). Returns (classmap[256], representatives)."""
+    signatures: dict[tuple, int] = {}
+    classmap = np.zeros(256, dtype=np.int32)
+    reps: list[int] = []
+    for b in range(256):
+        sig = tuple(cls >> b & 1 for cls in nfa.classes) + (
+            bool(WORD >> b & 1),
+            b == 0x0A,
+        )
+        cls_id = signatures.get(sig)
+        if cls_id is None:
+            cls_id = len(signatures)
+            signatures[sig] = cls_id
+            reps.append(b)
+        classmap[b] = cls_id
+    return classmap, reps
+
+
+def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192) -> DFA:
+    classmap, reps = _byte_classes(nfa)
+    n_classes = len(reps)
+
+    # DFA state: (frozenset of positions, prev_ctx bits).
+    initial = (frozenset(), _PREV_NONE)
+    index: dict[tuple, int] = {initial: 0}
+    worklist = [initial]
+    trans_rows: list[list[int]] = []
+    emit_rows: list[list[bool]] = []
+    end_rows: list[bool] = []
+
+    while worklist:
+        state = worklist.pop(0)
+        positions, prev_ctx = state
+        row_t: list[int] = []
+        row_e: list[bool] = []
+
+        # End-of-input match from this state?
+        at_end = _eval_dnf_ctx(nfa.empty_dnf, prev_ctx, None) or any(
+            _eval_dnf_ctx(nfa.accepts.get(p, FALSE_DNF), prev_ctx, None)
+            for p in positions
+        )
+        end_rows.append(at_end)
+
+        for b in reps:
+            emit = _eval_dnf_ctx(nfa.empty_dnf, prev_ctx, b) or any(
+                _eval_dnf_ctx(nfa.accepts.get(p, FALSE_DNF), prev_ctx, b)
+                for p in positions
+            )
+            nxt: set[int] = set()
+            for q, dnf in nfa.entries.items():
+                if nfa.classes[q] >> b & 1 and _eval_dnf_ctx(dnf, prev_ctx, b):
+                    nxt.add(q)
+            for p in positions:
+                for q, dnf in nfa.edges.get(p, {}).items():
+                    if nfa.classes[q] >> b & 1 and _eval_dnf_ctx(dnf, prev_ctx, b):
+                        nxt.add(q)
+            nxt_state = (frozenset(nxt), _prev_ctx_of(b))
+            nxt_id = index.get(nxt_state)
+            if nxt_id is None:
+                nxt_id = len(index)
+                if nxt_id >= max_states:
+                    raise DFAError(
+                        f"DFA exceeds {max_states} states "
+                        f"({nfa.n_positions} NFA positions)"
+                    )
+                index[nxt_state] = nxt_id
+                worklist.append(nxt_state)
+            row_t.append(nxt_id)
+            row_e.append(emit)
+        trans_rows.append(row_t)
+        emit_rows.append(row_e)
+
+    return DFA(
+        trans=np.asarray(trans_rows, dtype=np.int32),
+        emit=np.asarray(emit_rows, dtype=bool),
+        match_end=np.asarray(end_rows, dtype=bool),
+        classmap=classmap,
+        always_match=nfa.always_matches,
+    )
+
+
+def compile_regex_dfa(
+    pattern: str, case_insensitive: bool = False, max_states: int = 8192
+) -> DFA:
+    """Compile an RE2-subset pattern into scanner tables (search semantics)."""
+    ast = parse_regex(pattern, case_insensitive=case_insensitive)
+    nfa = build_position_nfa(ast)
+    return compile_nfa_dfa(nfa, max_states=max_states)
+
+
+def _literal_ast(literal: bytes, case_insensitive: bool) -> object:
+    items = []
+    for ch in literal:
+        mask = 1 << ch
+        items.append(RChar(case_fold(mask) if case_insensitive else mask))
+    if not items:
+        from .re_parser import REmpty
+
+        return REmpty()
+    return RCat(items) if len(items) > 1 else items[0]
+
+
+def literal_dfa(
+    literal: bytes,
+    case_insensitive: bool = False,
+    begins_with: bool = False,
+    ends_with: bool = False,
+    exact: bool = False,
+) -> DFA:
+    """DFA for literal operators: ``@contains`` (default), ``@beginsWith``,
+    ``@endsWith``, ``@streq``/``@within`` members (``exact``)."""
+    ast = _literal_ast(literal, case_insensitive)
+    from .re_parser import RAssert
+
+    if exact:
+        ast = RCat([RAssert("start"), ast, RAssert("end")])
+    elif begins_with:
+        ast = RCat([RAssert("start"), ast])
+    elif ends_with:
+        ast = RCat([ast, RAssert("end")])
+    nfa = build_position_nfa(ast)
+    return compile_nfa_dfa(nfa)
+
+
+def pm_dfa(words: list[bytes], max_states: int = 65536) -> DFA:
+    """DFA for ``@pm``/``@pmFromFile``: case-insensitive multi-literal match.
+    Subset construction over the alternation yields exactly the Aho-Corasick
+    automaton (cf. coraza's aho-corasick dependency, reference ``go.mod:52``)."""
+    branches = [_literal_ast(w, case_insensitive=True) for w in words if w]
+    if not branches:
+        raise DFAError("@pm requires at least one pattern")
+    ast = RAlt(branches) if len(branches) > 1 else branches[0]
+    nfa = build_position_nfa(ast)
+    return compile_nfa_dfa(nfa, max_states=max_states)

@@ -1,0 +1,244 @@
+"""Engine reconciler: attach a data plane for an Engine resource.
+
+Parity with reference ``internal/controller/engine_controller.go`` +
+``engine_controller_driver_istio.go``: driver dispatch, Istio/WASM
+provisioning builds a WasmPlugin named ``coraza-engine-<engine>`` whose
+pluginConfig carries ``cache_server_instance`` ("ns/rulesetName"),
+``cache_server_cluster`` (the operator flag) and
+``rule_reload_interval_seconds``; owner reference enables GC; server-side
+apply; Ready/Degraded conditions + events. Invalid driver shapes emit
+Warning/InvalidConfiguration + Degraded (``engine_controller.go:144-157``).
+
+New beyond the reference: the ``tpu`` driver provisions the tpu-engine
+sidecar Deployment (the north-star ``spec.driver.tpu`` mode), wired to the
+same cache poll contract — including the Engine's ``failurePolicy``, which
+the reference stores but never forwards (SURVEY §5 failure detection note);
+the sidecar actually enforces fail-closed/fail-open.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import get_logger
+from .api_types import DEFAULT_POLL_SECONDS, Engine, ObjectMeta
+from .conditions import set_status_degraded, set_status_progressing, set_status_ready
+from .events import EventRecorder
+from .ruleset_controller import ReconcileResult
+from .store import ObjectStore
+
+log = get_logger("controller.engine")
+
+WASM_PLUGIN_NAME_PREFIX = "coraza-engine-"
+TPU_ENGINE_NAME_PREFIX = "coraza-tpu-engine-"
+
+
+@dataclass
+class Unstructured:
+    """Dynamic object (WasmPlugin / Deployment manifests) stored alongside
+    typed resources — the unstructured.Unstructured analog."""
+
+    kind: str
+    api_version: str
+    metadata: ObjectMeta
+    spec: dict = field(default_factory=dict)
+
+
+class EngineReconciler:
+    kind = "Engine"
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        recorder: EventRecorder,
+        cache_server_cluster: str,
+        cache_server_port: int = 18080,
+    ):
+        self.store = store
+        self.recorder = recorder
+        # The Envoy cluster name through which the mesh reaches the cache
+        # server (reference --envoy-cluster-name, cmd/main.go:101,112-115).
+        self.cache_server_cluster = cache_server_cluster
+        self.cache_server_port = cache_server_port
+
+    def reconcile(self, namespace: str, name: str) -> ReconcileResult:
+        engine: Engine | None = self.store.try_get("Engine", namespace, name)
+        if engine is None or engine.metadata.deleted:
+            return ReconcileResult()
+
+        generation = engine.metadata.generation
+        set_status_progressing(
+            engine.status.conditions, generation, "Reconciling", "Provisioning engine"
+        )
+        self.store.update_status(engine)
+
+        driver = engine.spec.driver
+        if driver.istio is not None and driver.istio.wasm is not None:
+            return self._provision_istio_wasm(engine)
+        if driver.tpu is not None:
+            return self._provision_tpu(engine)
+        return self._invalid_configuration(
+            engine, "no supported driver configuration found"
+        )
+
+    # -- istio/wasm driver (reference parity) --------------------------------
+
+    def _provision_istio_wasm(self, engine: Engine) -> ReconcileResult:
+        plugin = self.build_wasm_plugin(engine)
+        try:
+            self.store.apply(plugin)
+        except Exception as err:  # provisioning failure path
+            msg = f"Failed to apply WasmPlugin: {err}"
+            self.recorder.event(engine, "Warning", "ProvisioningFailed", msg)
+            set_status_degraded(
+                engine.status.conditions,
+                engine.metadata.generation,
+                "ProvisioningFailed",
+                msg,
+            )
+            self.store.update_status(engine)
+            raise
+
+        msg = f"WasmPlugin {plugin.metadata.name} created"
+        self.recorder.event(engine, "Normal", "WasmPluginCreated", msg)
+        set_status_ready(
+            engine.status.conditions, engine.metadata.generation, "WasmPluginCreated", msg
+        )
+        self.store.update_status(engine)
+        return ReconcileResult()
+
+    def build_wasm_plugin(self, engine: Engine) -> Unstructured:
+        wasm = engine.spec.driver.istio.wasm
+        ruleset_key = f"{engine.metadata.namespace}/{engine.spec.rule_set.name}"
+        plugin_config: dict = {
+            "cache_server_instance": ruleset_key,
+            "cache_server_cluster": self.cache_server_cluster,
+        }
+        if wasm.rule_set_cache_server is not None:
+            plugin_config["rule_reload_interval_seconds"] = (
+                wasm.rule_set_cache_server.poll_interval_seconds
+            )
+        return Unstructured(
+            kind="WasmPlugin",
+            api_version="extensions.istio.io/v1alpha1",
+            metadata=ObjectMeta(
+                name=f"{WASM_PLUGIN_NAME_PREFIX}{engine.metadata.name}",
+                namespace=engine.metadata.namespace,
+                owner_references=[
+                    {
+                        "apiVersion": engine.api_version,
+                        "kind": engine.kind,
+                        "name": engine.metadata.name,
+                        "uid": engine.metadata.uid,
+                        "controller": True,
+                    }
+                ],
+            ),
+            spec={
+                "url": wasm.image,
+                "pluginConfig": plugin_config,
+                "selector": {
+                    "matchLabels": (wasm.workload_selector or {}).get("matchLabels", {})
+                },
+            },
+        )
+
+    # -- tpu driver (north star) ---------------------------------------------
+
+    def _provision_tpu(self, engine: Engine) -> ReconcileResult:
+        deployment = self.build_tpu_engine_deployment(engine)
+        try:
+            self.store.apply(deployment)
+        except Exception as err:
+            msg = f"Failed to apply tpu-engine Deployment: {err}"
+            self.recorder.event(engine, "Warning", "ProvisioningFailed", msg)
+            set_status_degraded(
+                engine.status.conditions,
+                engine.metadata.generation,
+                "ProvisioningFailed",
+                msg,
+            )
+            self.store.update_status(engine)
+            raise
+
+        msg = f"TPU engine {deployment.metadata.name} provisioned"
+        self.recorder.event(engine, "Normal", "TpuEngineProvisioned", msg)
+        set_status_ready(
+            engine.status.conditions,
+            engine.metadata.generation,
+            "TpuEngineProvisioned",
+            msg,
+        )
+        self.store.update_status(engine)
+        return ReconcileResult()
+
+    def build_tpu_engine_deployment(self, engine: Engine) -> Unstructured:
+        tpu = engine.spec.driver.tpu
+        ruleset_key = f"{engine.metadata.namespace}/{engine.spec.rule_set.name}"
+        poll = (
+            tpu.rule_set_cache_server.poll_interval_seconds
+            if tpu.rule_set_cache_server is not None
+            else DEFAULT_POLL_SECONDS
+        )
+        name = f"{TPU_ENGINE_NAME_PREFIX}{engine.metadata.name}"
+        args = [
+            f"--cache-server-instance={ruleset_key}",
+            f"--cache-server-cluster={self.cache_server_cluster}",
+            f"--cache-server-port={self.cache_server_port}",
+            f"--rule-reload-interval-seconds={poll}",
+            f"--failure-policy={engine.spec.failure_policy}",
+            f"--max-batch-size={tpu.max_batch_size}",
+            f"--max-batch-delay-ms={tpu.max_batch_delay_ms}",
+        ]
+        return Unstructured(
+            kind="Deployment",
+            api_version="apps/v1",
+            metadata=ObjectMeta(
+                name=name,
+                namespace=engine.metadata.namespace,
+                labels={"app": name},
+                owner_references=[
+                    {
+                        "apiVersion": engine.api_version,
+                        "kind": engine.kind,
+                        "name": engine.metadata.name,
+                        "uid": engine.metadata.uid,
+                        "controller": True,
+                    }
+                ],
+            ),
+            spec={
+                "replicas": tpu.replicas,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "tpu-engine",
+                                "image": tpu.image,
+                                "args": args,
+                                "ports": [{"containerPort": 9090, "name": "http"}],
+                                "resources": {
+                                    "limits": {"google.com/tpu": "1"},
+                                },
+                            }
+                        ]
+                    },
+                },
+            },
+        )
+
+    # -- failure path ---------------------------------------------------------
+
+    def _invalid_configuration(self, engine: Engine, detail: str) -> ReconcileResult:
+        msg = f"Invalid driver configuration: {detail}"
+        self.recorder.event(engine, "Warning", "InvalidConfiguration", msg)
+        set_status_degraded(
+            engine.status.conditions,
+            engine.metadata.generation,
+            "InvalidConfiguration",
+            msg,
+        )
+        self.store.update_status(engine)
+        return ReconcileResult()

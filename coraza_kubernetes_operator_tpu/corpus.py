@@ -1,0 +1,146 @@
+"""Shared rule/request corpus for benchmarks, the graft entry and tests.
+
+``sample_rules()`` mirrors the reference's sample RuleSet
+(``config/samples/ruleset.yaml``: base config + SQLi + XSS + evil-monkey).
+``synthetic_crs(n)`` generates a CRS-shaped ruleset (anomaly-scoring
+paranoia-style rules across attack categories) scaling to the
+``BASELINE.json`` configs (full CRS ≈ 800 rules; +5k synthetic @rx).
+``synthetic_requests(n)`` generates a benign/attack request mix shaped like
+the go-ftw corpus traffic.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .engine.request import HttpRequest
+
+BASE_RULES = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecRequestBodyLimit 131072
+SecRequestBodyInMemoryLimit 131072
+SecRequestBodyLimitAction Reject
+SecResponseBodyAccess Off
+SecAuditEngine RelevantOnly
+SecAuditLog /dev/stdout
+SecAuditLogFormat JSON
+SecDefaultAction "phase:1,log,auditlog,pass"
+SecDefaultAction "phase:2,log,auditlog,deny,status:403"
+"""
+
+SQLI_RULE = r"""
+SecRule ARGS "@rx (?i:(\b(select|union|insert|update|delete|drop|create|alter|exec|execute)\b.*\b(from|into|where|table|database|procedure)\b)|(\b(or|and)\b\s*['\"]?\d+['\"]?\s*=\s*['\"]?\d+)|('.*or.*'.*=.*'))" \
+  "id:1001,phase:2,block,t:none,t:urlDecodeUni,msg:'SQL Injection Attack Detected',tag:'attack-sqli',severity:'CRITICAL'"
+"""
+
+XSS_RULE = r"""
+SecRule ARGS "@rx (?i:<script[^>]*>.*?</script>|javascript:|onerror\s*=|onload\s*=|<iframe)" \
+  "id:2001,phase:2,block,t:none,t:urlDecodeUni,t:htmlEntityDecode,msg:'XSS Attack Detected',tag:'attack-xss',severity:'CRITICAL'"
+"""
+
+EVIL_MONKEY_RULE = r"""
+SecRule ARGS|REQUEST_URI|REQUEST_HEADERS "@contains evilmonkey" \
+  "id:3001,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'Evil Monkey Detected',tag:'monkey-attack',severity:'CRITICAL'"
+"""
+
+
+def sample_rules() -> str:
+    return BASE_RULES + SQLI_RULE + XSS_RULE + EVIL_MONKEY_RULE
+
+
+_CATEGORIES = [
+    # (id base, variable, patterns)
+    (920, "REQUEST_URI", [r"\.\./", r"%00", r"\x00", r"/etc/+passwd", r"\.git/"]),
+    (941, "ARGS", [
+        r"(?i:<script[^>]*>)", r"(?i:javascript:)", r"(?i:on(error|load|click)\s*=)",
+        r"(?i:<iframe)", r"(?i:<svg[^>]*onload)", r"(?i:alert\s*\()",
+    ]),
+    (942, "ARGS", [
+        r"(?i:\bunion\s+(all\s+)?select\b)", r"(?i:\bselect\b.+\bfrom\b)",
+        r"(?i:\binsert\s+into\b)", r"(?i:\bdrop\s+table\b)",
+        r"(?i:\b(or|and)\b\s+\d+\s*=\s*\d+)", r"(?i:sleep\s*\(\s*\d+\s*\))",
+        r"(?i:benchmark\s*\()", r"(?i:information_schema)",
+    ]),
+    (930, "ARGS|REQUEST_URI", [r"(?i:etc/passwd)", r"(?i:boot\.ini)", r"(?i:proc/self/environ)"]),
+    (932, "ARGS", [r"(?i:;\s*(cat|ls|id|whoami)\b)", r"(?i:\|\s*(cat|nc|bash)\b)", r"(?i:\$\(.*\))"]),
+    (933, "ARGS", [r"(?i:php://)", r"(?i:base64_decode\s*\()", r"(?i:eval\s*\()"]),
+]
+
+_SETUP = """
+SecAction "id:900110,phase:1,pass,nolog,\
+setvar:tx.inbound_anomaly_score_threshold=5,\
+setvar:tx.critical_anomaly_score=5,\
+setvar:tx.error_anomaly_score=4"
+"""
+
+_BLOCKING_RULE = """
+SecRule TX:INBOUND_ANOMALY_SCORE "@ge %{tx.inbound_anomaly_score_threshold}" \
+  "id:949110,phase:2,deny,status:403,t:none,msg:'Inbound Anomaly Score Exceeded'"
+"""
+
+
+def synthetic_crs(n_rules: int = 200, seed: int = 0) -> str:
+    """CRS-shaped anomaly-scoring ruleset with ~n_rules detection rules."""
+    rng = random.Random(seed)
+    out = [BASE_RULES, _SETUP]
+    made = 0
+    i = 0
+    while made < n_rules:
+        base_id, var, patterns = _CATEGORIES[i % len(_CATEGORIES)]
+        pattern = patterns[i % len(patterns)]
+        rule_id = base_id * 1000 + 100 + i
+        if made >= len(_CATEGORIES) * 8:
+            # Synthetic uniques beyond the hand-written set (config #4 shape).
+            token = f"attack{rng.randrange(10**6)}x{i}"
+            pattern = rf"(?i:\b{token}\b\s*=\s*\d+)"
+        out.append(
+            f'SecRule {var} "@rx {pattern}" '
+            f"\"id:{rule_id},phase:2,pass,t:none,t:urlDecodeUni,"
+            f"msg:'synthetic rule {rule_id}',"
+            f"setvar:tx.inbound_anomaly_score=+%{{tx.critical_anomaly_score}}\""
+        )
+        made += 1
+        i += 1
+    out.append(_BLOCKING_RULE)
+    return "\n".join(out)
+
+
+_BENIGN_PATHS = [
+    "/", "/index.html", "/api/v1/items", "/static/app.js", "/login",
+    "/products?id=123&sort=asc", "/search?q=blue+widgets", "/health",
+    "/api/users/42/profile", "/images/logo.png?v=2",
+]
+_ATTACK_QUERIES = [
+    "/search?q=1%27%20UNION%20SELECT%20password%20FROM%20users--",
+    "/item?id=1 or 1=1",
+    "/page?x=<script>alert(1)</script>",
+    "/view?f=../../../../etc/passwd",
+    "/api?cmd=;cat /etc/passwd",
+    "/q?a=sleep(10)",
+    "/x?y=%3Cscript%20src=evil.js%3E",
+    "/dl?f=php://filter/convert.base64-encode",
+]
+
+
+def synthetic_requests(n: int, attack_ratio: float = 0.1, seed: int = 0) -> list[HttpRequest]:
+    rng = random.Random(seed)
+    out: list[HttpRequest] = []
+    for i in range(n):
+        attack = rng.random() < attack_ratio
+        if attack:
+            uri = rng.choice(_ATTACK_QUERIES)
+        else:
+            uri = rng.choice(_BENIGN_PATHS)
+        headers = [
+            ("Host", "bench.local"),
+            ("User-Agent", "bench-client/1.0"),
+            ("Accept", "*/*"),
+        ]
+        if rng.random() < 0.3:
+            body = f"field1=value{i}&field2={'benign+data+' * rng.randrange(1, 5)}".encode()
+            headers.append(("Content-Type", "application/x-www-form-urlencoded"))
+            out.append(HttpRequest(method="POST", uri=uri, headers=headers, body=body))
+        else:
+            out.append(HttpRequest(method="GET", uri=uri, headers=headers))
+    return out

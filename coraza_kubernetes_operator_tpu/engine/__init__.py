@@ -1,0 +1,12 @@
+"""Batch WAF engine: request tensorization, jitted evaluation, sidecar.
+
+This package is the first-party replacement for the external
+``coraza-proxy-wasm`` data plane the reference attaches to gateways
+(SURVEY §2.2): requests are batched into byte tensors, evaluated on TPU via
+``models/waf_model.py``, and the sidecar speaks the same cache-poll hot
+reload protocol as the reference's WASM plugin
+(``engine_controller_driver_istio.go:96-103``).
+"""
+
+from .request import HttpRequest  # noqa: F401
+from .waf import Verdict, WafEngine  # noqa: F401

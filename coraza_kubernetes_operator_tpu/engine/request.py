@@ -1,0 +1,246 @@
+"""HTTP request model and target extraction.
+
+Extraction maps a request to the byte targets and numeric variables the
+compiled ruleset needs: the host-side half of tensorization (the device half
+is ``models/waf_model.eval_waf``). Variable semantics follow ModSecurity as
+exercised by the reference corpus: ARGS are URL-decoded key/values from the
+query string and form/JSON bodies, REQUEST_HEADERS are raw values keyed by
+lower-cased name, REQUEST_URI includes the query, JSON bodies are flattened
+to dotted paths (the base rules select the JSON body processor by
+Content-Type — reference ``hack/generate_coreruleset_configmaps.py`` rules
+200001/200006).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ..compiler.ruleset import (
+    COLLECTIONS,
+    CompiledRuleSet,
+    NUMERIC_SCALARS,
+    SCALARS,
+)
+from ..compiler.transforms_host import t_urldecode
+
+
+@dataclass
+class HttpRequest:
+    """One HTTP request to evaluate. ``headers`` preserves order and repeats."""
+
+    method: str = "GET"
+    uri: str = "/"
+    version: str = "HTTP/1.1"
+    headers: list[tuple[str, str]] = field(default_factory=list)
+    body: bytes = b""
+    remote_addr: str = ""
+
+    def header(self, name: str) -> str | None:
+        name = name.lower()
+        for k, v in self.headers:
+            if k.lower() == name:
+                return v
+        return None
+
+    @property
+    def path(self) -> str:
+        return self.uri.split("?", 1)[0]
+
+    @property
+    def query_string(self) -> str:
+        parts = self.uri.split("?", 1)
+        return parts[1] if len(parts) == 2 else ""
+
+
+@dataclass
+class ExtractedTarget:
+    collection: str
+    name: str | None  # selector key (lower-cased at match time)
+    value: bytes
+
+
+@dataclass
+class Extraction:
+    targets: list[ExtractedTarget]
+    numerics: dict[tuple, int]
+
+
+def _parse_pairs(raw: str, sep: str = "&") -> list[tuple[bytes, bytes]]:
+    pairs: list[tuple[bytes, bytes]] = []
+    for item in raw.split(sep):
+        if not item:
+            continue
+        key, _, value = item.partition("=")
+        pairs.append(
+            (
+                t_urldecode(key.encode("latin-1", "replace")),
+                t_urldecode(value.encode("latin-1", "replace")),
+            )
+        )
+    return pairs
+
+
+def _flatten_json(obj, prefix: str, out: list[tuple[bytes, bytes]]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten_json(v, f"{prefix}.{k}" if prefix else str(k), out)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _flatten_json(v, f"{prefix}.{i}" if prefix else str(i), out)
+    else:
+        if isinstance(obj, bool):
+            val = b"true" if obj else b"false"
+        elif obj is None:
+            val = b""
+        else:
+            val = str(obj).encode("utf-8", "replace")
+        out.append((prefix.encode("utf-8", "replace"), val))
+
+
+class TargetExtractor:
+    """Extracts targets/numerics for one compiled ruleset."""
+
+    def __init__(self, crs: CompiledRuleSet):
+        self.crs = crs
+        self.vocab = crs.vocab
+        self.body_access = crs.program.request_body_access
+        self.body_limit = crs.program.request_body_limit
+
+    def extract(self, req: HttpRequest) -> Extraction:
+        targets: list[ExtractedTarget] = []
+        body = req.body[: self.body_limit]
+        reqbody_error = 0
+
+        args_get = _parse_pairs(req.query_string)
+        args_post: list[tuple[bytes, bytes]] = []
+        processor = ""
+        if self.body_access and body:
+            ctype = (req.header("content-type") or "").lower()
+            if "json" in ctype:
+                processor = "JSON"
+                try:
+                    _flatten_json(json.loads(body.decode("utf-8", "replace")), "json", args_post)
+                except (ValueError, RecursionError):
+                    reqbody_error = 1
+            elif "x-www-form-urlencoded" in ctype or not ctype:
+                processor = "URLENCODED"
+                args_post = _parse_pairs(body.decode("latin-1", "replace"))
+
+        def add(collection: str, name: str | None, value: bytes) -> None:
+            targets.append(ExtractedTarget(collection, name, value))
+
+        for k, v in args_get:
+            kn = k.decode("latin-1", "replace")
+            add("ARGS", kn, v)
+            add("ARGS_GET", kn, v)
+            add("ARGS_NAMES", kn, k)
+            add("ARGS_GET_NAMES", kn, k)
+        for k, v in args_post:
+            kn = k.decode("latin-1", "replace")
+            add("ARGS", kn, v)
+            add("ARGS_POST", kn, v)
+            add("ARGS_NAMES", kn, k)
+            add("ARGS_POST_NAMES", kn, k)
+
+        for hk, hv in req.headers:
+            add("REQUEST_HEADERS", hk, hv.encode("latin-1", "replace"))
+            add("REQUEST_HEADERS_NAMES", hk, hk.encode("latin-1", "replace"))
+        cookie = req.header("cookie")
+        if cookie:
+            for part in cookie.split(";"):
+                name, _, value = part.strip().partition("=")
+                add("REQUEST_COOKIES", name, value.encode("latin-1", "replace"))
+                add("REQUEST_COOKIES_NAMES", name, name.encode("latin-1", "replace"))
+
+        path = req.path
+        basename = path.rsplit("/", 1)[-1]
+        request_line = f"{req.method} {req.uri} {req.version}"
+        full_request = (
+            request_line
+            + "\r\n"
+            + "".join(f"{k}: {v}\r\n" for k, v in req.headers)
+            + "\r\n"
+        ).encode("latin-1", "replace") + body
+
+        scalars: dict[str, bytes] = {
+            "REQUEST_URI": req.uri.encode("latin-1", "replace"),
+            "REQUEST_URI_RAW": req.uri.encode("latin-1", "replace"),
+            "REQUEST_FILENAME": path.encode("latin-1", "replace"),
+            "REQUEST_BASENAME": basename.encode("latin-1", "replace"),
+            "REQUEST_LINE": request_line.encode("latin-1", "replace"),
+            "REQUEST_METHOD": req.method.encode("latin-1", "replace"),
+            "REQUEST_PROTOCOL": req.version.encode("latin-1", "replace"),
+            "QUERY_STRING": req.query_string.encode("latin-1", "replace"),
+            "REQUEST_BODY": body if self.body_access else b"",
+            "FULL_REQUEST": full_request,
+            "PATH_INFO": b"",
+            "REMOTE_ADDR": req.remote_addr.encode("latin-1", "replace"),
+            "SERVER_NAME": (req.header("host") or "").encode("latin-1", "replace"),
+            "STATUS_LINE": b"",
+            "RESPONSE_BODY": b"",
+            "AUTH_TYPE": b"",
+            "REQBODY_PROCESSOR": processor.encode("ascii"),
+        }
+        for name, value in scalars.items():
+            if (name, None) in self.vocab.kinds:
+                add(name, None, value)
+
+        args_combined = sum(len(k) + len(v) for k, v in args_get + args_post)
+        numeric_values = {
+            "REQUEST_BODY_LENGTH": len(body),
+            "REQBODY_ERROR": reqbody_error,
+            "MULTIPART_STRICT_ERROR": 0,
+            "MULTIPART_UNMATCHED_BOUNDARY": 0,
+            "ARGS_COMBINED_SIZE": args_combined,
+            "FULL_REQUEST_LENGTH": len(full_request),
+            "FILES_COMBINED_SIZE": 0,
+            "RESPONSE_STATUS": 0,
+            "DURATION": 0,
+        }
+        # Numeric scalars used with string operators appear as byte targets.
+        for name, value in numeric_values.items():
+            if (name, None) in self.vocab.kinds:
+                add(name, None, str(value).encode("ascii"))
+
+        numerics: dict[tuple, int] = {}
+        for key, _nv in self.crs.numvars.vars.items():
+            if key[0] == "scalar":
+                numerics[key] = numeric_values.get(key[1], 0)
+            else:  # ('count', collection, selector)
+                _, coll, sel = key
+                count = 0
+                for t in targets:
+                    if t.collection != coll:
+                        continue
+                    if sel is None or (t.name or "").lower() == sel:
+                        count += 1
+                numerics[key] = count
+        return Extraction(targets=targets, numerics=numerics)
+
+    def kind_ids(self, target: ExtractedTarget) -> list[int]:
+        """All kind ids this target belongs to (generic, exact selector, and
+        every matching regex selector). The batcher packs three per tensor
+        row and duplicates rows for overflow, so a name matching several
+        regex selectors stays visible to every rule."""
+        coll = target.collection
+        kinds: list[int] = []
+        if coll in COLLECTIONS:
+            generic = self.vocab.lookup(coll, None)
+            if generic:
+                kinds.append(generic)
+            if target.name:
+                exact = self.vocab.lookup(coll, target.name)
+                if exact:
+                    kinds.append(exact)
+                name_b = target.name.encode("latin-1", "replace")
+                for dfa, kid in self.vocab.regex_kinds_for(coll):
+                    if dfa.search(name_b):
+                        kinds.append(kid)
+            return kinds
+        # Scalars: single exact kind.
+        if coll in SCALARS or coll in NUMERIC_SCALARS:
+            kid = self.vocab.lookup(coll, None)
+            if kid:
+                kinds.append(kid)
+        return kinds

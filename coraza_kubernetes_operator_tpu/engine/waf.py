@@ -1,0 +1,194 @@
+"""WafEngine: compile once, evaluate request batches on device.
+
+The facade ties together the Seclang compiler, the target extractor, the
+device model and shape-bucketing. Shapes are padded to power-of-two buckets
+(targets, requests, byte length) so XLA retraces only on bucket growth —
+steady-state serving reuses cached executables (the XLA analog of the
+reference data plane's compiled-once WASM rules).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.ruleset import CompiledRuleSet, compile_rules
+from ..compiler.transforms_host import apply_pipeline
+from ..models.waf_model import WafModel, build_model, eval_waf
+from ..utils import get_logger
+from .request import Extraction, HttpRequest, TargetExtractor
+
+log = get_logger("engine.waf")
+
+_MIN_LEN = 32
+
+
+def _bucket(n: int, lo: int = 1) -> int:
+    size = lo
+    while size < n:
+        size *= 2
+    return size
+
+
+@dataclass
+class Verdict:
+    """Per-request evaluation outcome (the sidecar turns this into 403/200,
+    honoring the Engine's failurePolicy — reference
+    ``api/v1alpha1/engine_types.go:153-166``)."""
+
+    interrupted: bool
+    status: int
+    rule_id: int | None
+    matched_ids: list[int] = field(default_factory=list)
+    scores: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def allowed(self) -> bool:
+        return not self.interrupted
+
+
+class WafEngine:
+    """A compiled ruleset plus its jitted batch evaluator."""
+
+    def __init__(self, rules: str | CompiledRuleSet):
+        self.compiled = rules if isinstance(rules, CompiledRuleSet) else compile_rules(rules)
+        self.model: WafModel = build_model(self.compiled)
+        self.extractor = TargetExtractor(self.compiled)
+        self._n_real_rules = len(self.compiled.rules)  # model pads to ≥1 row
+        self._rule_ids = np.asarray(
+            [r.rule_id for r in self.compiled.rules] or [0], dtype=np.int64
+        )
+        self._host_pipelines = self.compiled.host_pipelines()
+        # Kinds visible to each host pipeline — rows outside the set skip the
+        # (sequential, Python) transform on the hot path.
+        self._host_pipeline_kinds: list[set[int]] = []
+        for pid, _names in self._host_pipelines:
+            kinds: set[int] = set()
+            for link in self.compiled.links:
+                if link.group >= 0 and self.compiled.group_pipeline[link.group] == pid:
+                    kinds.update(link.include_kinds)
+            self._host_pipeline_kinds.append(kinds)
+        if self.compiled.report.skipped:
+            log.info(
+                "compiled with skipped rules",
+                skipped=len(self.compiled.report.skipped),
+                rules=self.compiled.n_rules,
+                groups=self.compiled.n_groups,
+            )
+
+    # -- batching -----------------------------------------------------------
+
+    def _tensorize(self, extractions: list[Extraction]):
+        body_cap = max(_MIN_LEN, self.compiled.program.request_body_limit)
+        rows: list[tuple[int, bytes, tuple[int, int, int]]] = []
+        for i, ex in enumerate(extractions):
+            for t in ex.targets:
+                kinds = self.extractor.kind_ids(t)
+                if not kinds:
+                    continue  # no rule looks at this target
+                # Three kind slots per row; extra kinds get duplicate rows.
+                for off in range(0, len(kinds), 3):
+                    chunk = kinds[off : off + 3]
+                    chunk += [0] * (3 - len(chunk))
+                    rows.append((i, t.value[:body_cap], tuple(chunk)))
+
+        n_req = _bucket(max(1, len(extractions)))
+        n_targets = _bucket(max(1, len(rows)))
+        h = len(self._host_pipelines)
+
+        # Host-pipeline variants computed per row; length bucket covers all.
+        # Only rows whose kinds some rule under that pipeline can see are
+        # transformed — the rest stay empty (no rule reads them).
+        variants: list[list[bytes]] = [
+            [
+                apply_pipeline(value, list(names))[:body_cap]
+                if any(k in self._host_pipeline_kinds[hi] for k in kinds if k)
+                else b""
+                for hi, (_, names) in enumerate(self._host_pipelines)
+            ]
+            for _, value, kinds in rows
+        ]
+        max_len = max(
+            [len(v) for _, v, _ in rows]
+            + [len(x) for vs in variants for x in vs]
+            + [1]
+        )
+        length = _bucket(max(_MIN_LEN, max_len))
+
+        data = np.zeros((n_targets, length), dtype=np.uint8)
+        lengths = np.zeros(n_targets, dtype=np.int32)
+        kind1 = np.zeros(n_targets, dtype=np.int32)
+        kind2 = np.zeros(n_targets, dtype=np.int32)
+        kind3 = np.zeros(n_targets, dtype=np.int32)
+        req_id = np.full(n_targets, n_req, dtype=np.int32)  # padding bucket
+        vdata = np.zeros((max(h, 1), n_targets, length), dtype=np.uint8)
+        vlengths = np.zeros((max(h, 1), n_targets), dtype=np.int32)
+
+        for row, (ri, value, kinds) in enumerate(rows):
+            data[row, : len(value)] = np.frombuffer(value, dtype=np.uint8)
+            lengths[row] = len(value)
+            kind1[row], kind2[row], kind3[row] = kinds
+            req_id[row] = ri
+            for hi in range(h):
+                hv = variants[row][hi]
+                vdata[hi, row, : len(hv)] = np.frombuffer(hv, dtype=np.uint8)
+                vlengths[hi, row] = len(hv)
+
+        nv = self.compiled.numvars.n_vars
+        numvals = np.zeros((n_req, nv), dtype=np.int32)
+        for i, ex in enumerate(extractions):
+            for key, value in ex.numerics.items():
+                numvals[i, self.compiled.numvars.vars[key]] = value
+
+        return (
+            jnp.asarray(data),
+            jnp.asarray(lengths),
+            jnp.asarray(kind1),
+            jnp.asarray(kind2),
+            jnp.asarray(kind3),
+            jnp.asarray(req_id),
+            jnp.asarray(numvals),
+            jnp.asarray(vdata),
+            jnp.asarray(vlengths),
+        )
+
+    # -- public API ---------------------------------------------------------
+
+    def evaluate(self, requests: list[HttpRequest]) -> list[Verdict]:
+        """Evaluate a request batch; returns one Verdict per request."""
+        if not requests:
+            return []
+        extractions = [self.extractor.extract(r) for r in requests]
+        tensors = self._tensorize(extractions)
+        out = eval_waf(self.model, *tensors)
+        matched = np.asarray(out["matched"])
+        interrupted = np.asarray(out["interrupted"])
+        status = np.asarray(out["status"])
+        rule_index = np.asarray(out["rule_index"])
+        scores = np.asarray(out["scores"])
+
+        verdicts: list[Verdict] = []
+        for i in range(len(requests)):
+            ridx = int(rule_index[i])
+            verdicts.append(
+                Verdict(
+                    interrupted=bool(interrupted[i]),
+                    status=int(status[i]),
+                    rule_id=int(self._rule_ids[ridx]) if ridx >= 0 else None,
+                    matched_ids=[
+                        int(self._rule_ids[j])
+                        for j in np.flatnonzero(matched[i])
+                        if j < self._n_real_rules  # drop the ≥1-row pad rule
+                    ],
+                    scores={
+                        name: int(scores[i, c])
+                        for c, name in enumerate(self.compiled.counters)
+                    },
+                )
+            )
+        return verdicts
+
+    def evaluate_one(self, request: HttpRequest) -> Verdict:
+        return self.evaluate([request])[0]

@@ -1,0 +1,9 @@
+"""Compiled matcher model families.
+
+``waf_model`` is the flagship: the full Seclang ruleset lowered to a jittable
+pytree (DFA banks + link/rule metadata + anomaly-score counters) whose
+``eval_waf`` is the per-batch forward step the engine, benchmarks and
+``__graft_entry__`` all share.
+"""
+
+from .waf_model import WafModel, build_model, eval_waf  # noqa: F401

@@ -1,0 +1,374 @@
+"""Device WAF model: CompiledRuleSet → pytree + jittable batch evaluation.
+
+Evaluation pipeline (all shape-static, one ``jit`` trace per bucket shape):
+
+1. transform: apply each distinct device transform pipeline to the target
+   buffer (host-only pipelines arrive pre-transformed as variant buffers);
+2. match: scan every DFA bank over its pipeline's buffer → per-target,
+   per-group hits;
+3. incidence: two bool-table gathers resolve which rules see which targets
+   (variable include/exclude semantics);
+4. reduce: scatter-max targets → requests, AND chain links, matmul match
+   flags into anomaly-score counters, evaluate threshold links;
+5. verdict: first-match-wins disruptive decision honoring phases and
+   SecRuleEngine mode.
+
+The reference delegates all of this to coraza-proxy-wasm per request
+(SURVEY §3.4); here it is one fused batch computation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.ruleset import (
+    CompiledRuleSet,
+    DEC_ALLOW,
+    DEC_DENY,
+    DEC_DROP,
+    DEC_REDIRECT,
+    LINK_ALWAYS,
+    LINK_COUNTER,
+    LINK_NEVER,
+    LINK_NUMERIC,
+    LINK_STRING,
+)
+from ..ops.dfa import DFABank, stack_dfas
+from ..ops.transforms import apply_device_pipeline
+
+_BIG = jnp.int32(2**31 - 1)
+
+# Size buckets for DFA banks (n_states ceiling): groups whose tables fit the
+# same bucket share one padded bank — bounded padding waste, few fused scans.
+_STATE_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class WafModel:
+    """Pytree of device arrays + static metadata (hashable aux)."""
+
+    banks: list[DFABank]
+    # link arrays [Rl]
+    ltype: jnp.ndarray
+    lneg: jnp.ndarray
+    lgroup: jnp.ndarray
+    lnumvar: jnp.ndarray
+    lcmp: jnp.ndarray
+    lcmparg: jnp.ndarray
+    lcounter: jnp.ndarray
+    # incidence [K+1, Rl]
+    inc: jnp.ndarray
+    exc: jnp.ndarray
+    # rule arrays [Rr]
+    link_matrix: jnp.ndarray  # [Rr, MX]
+    link_mask: jnp.ndarray  # [Rr, MX]
+    decision: jnp.ndarray
+    status: jnp.ndarray
+    order_key: jnp.ndarray
+    phase: jnp.ndarray
+    # counters
+    weights: jnp.ndarray  # [Rr, C]
+    counter_base: jnp.ndarray  # [C]
+    # static metadata
+    bank_pipelines: tuple = field(default_factory=tuple)  # pipeline id per bank
+    pipelines: tuple = field(default_factory=tuple)  # names per pipeline id
+    pipeline_device: tuple = field(default_factory=tuple)
+    host_variant_index: tuple = field(default_factory=tuple)  # pid -> variant slot (-1 device)
+    engine_on: bool = True
+    detection_only: bool = False
+
+    def tree_flatten(self):
+        leaves = (
+            self.banks,
+            self.ltype,
+            self.lneg,
+            self.lgroup,
+            self.lnumvar,
+            self.lcmp,
+            self.lcmparg,
+            self.lcounter,
+            self.inc,
+            self.exc,
+            self.link_matrix,
+            self.link_mask,
+            self.decision,
+            self.status,
+            self.order_key,
+            self.phase,
+            self.weights,
+            self.counter_base,
+        )
+        aux = (
+            self.bank_pipelines,
+            self.pipelines,
+            self.pipeline_device,
+            self.host_variant_index,
+            self.engine_on,
+            self.detection_only,
+        )
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, *aux)
+
+    @property
+    def n_rules(self) -> int:
+        return int(self.decision.shape[0])
+
+    @property
+    def n_counters(self) -> int:
+        return int(self.counter_base.shape[0])
+
+
+def build_model(crs: CompiledRuleSet) -> WafModel:
+    """Lay out a CompiledRuleSet as device arrays. Groups are re-ordered so
+    each bank's groups are contiguous; links are rewritten accordingly."""
+    # Bucket groups: (pipeline_id, state_bucket) → [group ids]
+    buckets: dict[tuple[int, int], list[int]] = {}
+    for gid, grp in enumerate(crs.groups):
+        s = grp.dfa.n_states
+        bucket = next(b for b in _STATE_BUCKETS if s <= b)
+        buckets.setdefault((crs.group_pipeline[gid], bucket), []).append(gid)
+
+    banks: list[DFABank] = []
+    bank_pipelines: list[int] = []
+    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
+    next_new = 0
+    for (pid, _bucket), gids in sorted(buckets.items()):
+        banks.append(stack_dfas([crs.groups[g].dfa for g in gids]))
+        bank_pipelines.append(pid)
+        for g in gids:
+            remap[g] = next_new
+            next_new += 1
+
+    # Host pipeline variant slots.
+    host_variant_index = []
+    slot = 0
+    for dev in crs.pipeline_device:
+        if dev:
+            host_variant_index.append(-1)
+        else:
+            host_variant_index.append(slot)
+            slot += 1
+
+    rl = max(1, len(crs.links))
+    ltype = np.full(rl, LINK_NEVER, dtype=np.int32)
+    lneg = np.zeros(rl, dtype=bool)
+    lgroup = np.zeros(rl, dtype=np.int32)
+    lnumvar = np.zeros(rl, dtype=np.int32)
+    lcmp = np.zeros(rl, dtype=np.int32)
+    lcmparg = np.zeros(rl, dtype=np.int32)
+    lcounter = np.zeros(rl, dtype=np.int32)
+    k = crs.vocab.n_kinds
+    inc = np.zeros((k, rl), dtype=bool)
+    exc = np.zeros((k, rl), dtype=bool)
+    for i, link in enumerate(crs.links):
+        ltype[i] = link.link_type
+        lneg[i] = link.negated
+        if link.link_type == LINK_STRING:
+            lgroup[i] = remap[link.group]
+            for kid in link.include_kinds:
+                inc[kid, i] = True
+            for kid in link.exclude_kinds:
+                exc[kid, i] = True
+        lnumvar[i] = max(0, link.numvar)
+        lcmp[i] = link.cmp
+        lcmparg[i] = link.cmp_arg
+        lcounter[i] = max(0, link.counter)
+
+    rr = max(1, len(crs.rules))
+    mx = max([len(r.link_ids) for r in crs.rules] or [1])
+    link_matrix = np.zeros((rr, mx), dtype=np.int32)
+    link_mask = np.zeros((rr, mx), dtype=bool)
+    decision = np.zeros(rr, dtype=np.int32)
+    status = np.zeros(rr, dtype=np.int32)
+    order_key = np.full(rr, 2**31 - 1, dtype=np.int32)
+    phase = np.full(rr, 99, dtype=np.int32)
+    for i, rule in enumerate(crs.rules):
+        for j, lid in enumerate(rule.link_ids):
+            link_matrix[i, j] = lid
+            link_mask[i, j] = True
+        decision[i] = rule.decision
+        status[i] = rule.status
+        order_key[i] = rule.order_key
+        phase[i] = rule.phase
+
+    weights = crs.weights if crs.weights.size else np.zeros((rr, 1), dtype=np.int32)
+    if weights.shape[0] != rr:
+        padded = np.zeros((rr, weights.shape[1]), dtype=np.int32)
+        padded[: weights.shape[0]] = weights
+        weights = padded
+
+    return WafModel(
+        banks=banks,
+        ltype=jnp.asarray(ltype),
+        lneg=jnp.asarray(lneg),
+        lgroup=jnp.asarray(lgroup),
+        lnumvar=jnp.asarray(lnumvar),
+        lcmp=jnp.asarray(lcmp),
+        lcmparg=jnp.asarray(lcmparg),
+        lcounter=jnp.asarray(lcounter),
+        inc=jnp.asarray(inc),
+        exc=jnp.asarray(exc),
+        link_matrix=jnp.asarray(link_matrix),
+        link_mask=jnp.asarray(link_mask),
+        decision=jnp.asarray(decision),
+        status=jnp.asarray(status),
+        order_key=jnp.asarray(order_key),
+        phase=jnp.asarray(phase),
+        weights=jnp.asarray(weights.astype(np.int32)),
+        counter_base=jnp.asarray(
+            crs.counter_base if crs.counter_base.size else np.zeros(1, np.int32)
+        ),
+        bank_pipelines=tuple(bank_pipelines),
+        pipelines=tuple(tuple(p) for p in crs.pipelines),
+        pipeline_device=tuple(crs.pipeline_device),
+        host_variant_index=tuple(host_variant_index),
+        engine_on=crs.engine_mode != "Off",
+        detection_only=crs.engine_mode == "DetectionOnly",
+    )
+
+
+def _compare(cmp: jnp.ndarray, left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized six-way comparison (codes from operators.CMP_CODES)."""
+    return jnp.select(
+        [cmp == 0, cmp == 1, cmp == 2, cmp == 3, cmp == 4, cmp == 5],
+        [left == right, left != right, left >= right, left > right, left <= right, left < right],
+        default=False,
+    )
+
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_waf(
+    model: WafModel,
+    data: jnp.ndarray,  # [T, L] uint8 base target buffer
+    lengths: jnp.ndarray,  # [T]
+    kind1: jnp.ndarray,  # [T] target kind ids (0 = none)
+    kind2: jnp.ndarray,
+    kind3: jnp.ndarray,
+    req_id: jnp.ndarray,  # [T] owning request (B = padding bucket)
+    numvals: jnp.ndarray,  # [B, NV] int32
+    variant_data: jnp.ndarray,  # [H, T, L] host-pipeline variants
+    variant_lengths: jnp.ndarray,  # [H, T]
+    max_phase: int = 2,
+):
+    """Evaluate one batch. Returns a dict of per-request verdict arrays."""
+    b = numvals.shape[0]
+
+    # 1+2: transforms + DFA bank scans → per-target group hits.
+    per_bank: list[jnp.ndarray] = []
+    transformed: dict[int, tuple[jnp.ndarray, jnp.ndarray]] = {}
+    from ..ops.dfa import scan_dfa_bank
+
+    for bank, pid in zip(model.banks, model.bank_pipelines):
+        if pid not in transformed:
+            slot = model.host_variant_index[pid]
+            if slot >= 0:
+                transformed[pid] = (variant_data[slot], variant_lengths[slot])
+            else:
+                transformed[pid] = apply_device_pipeline(
+                    data, lengths, model.pipelines[pid]
+                )
+        tdata, tlen = transformed[pid]
+        per_bank.append(scan_dfa_bank(bank, tdata, tlen))
+    if per_bank:
+        group_hits = jnp.concatenate(per_bank, axis=1)  # [T, G]
+    else:
+        group_hits = jnp.zeros((data.shape[0], 1), dtype=bool)
+
+    return post_match(
+        model, group_hits, kind1, kind2, kind3, req_id, numvals, max_phase
+    )
+
+
+def post_match(
+    model: WafModel,
+    group_hits: jnp.ndarray,  # [T, G]
+    kind1: jnp.ndarray,
+    kind2: jnp.ndarray,
+    kind3: jnp.ndarray,
+    req_id: jnp.ndarray,
+    numvals: jnp.ndarray,
+    max_phase: int = 2,
+):
+    """Stages 3-5: incidence, reductions, counters, verdict. Shared by the
+    single-chip path and the sharded path (``parallel/mesh.py``), which
+    arrives here after all-gathering rule-sharded group hits."""
+    b = numvals.shape[0]
+
+    # 3: incidence + per-target link matches.
+    gm = group_hits[:, model.lgroup]  # [T, Rl]
+    rel = model.inc[kind1] | model.inc[kind2] | model.inc[kind3]
+    excl = model.exc[kind1] | model.exc[kind2] | model.exc[kind3]
+    str_t = rel & ~excl & (gm ^ model.lneg[None, :])  # [T, Rl]
+
+    # 4a: targets → requests. One-hot matmul instead of scatter: scatters
+    # serialize on TPU while this contraction rides the MXU (it also avoids
+    # an XLA:CPU miscompile where scatter-max over a fused gather operand
+    # read zeros). Padding rows carry req_id == B and select no column.
+    onehot = (req_id[:, None] == jnp.arange(b, dtype=req_id.dtype)[None, :])  # [T, B]
+    m_str = (
+        jnp.einsum(
+            "tb,tr->br",
+            onehot.astype(jnp.int32),
+            str_t.astype(jnp.int32),
+            preferred_element_type=jnp.int32,
+        )
+        > 0
+    )  # [B, Rl]
+
+    # 4b: numeric links.
+    vals = numvals[:, model.lnumvar]  # [B, Rl]
+    m_num = _compare(model.lcmp[None, :], vals, model.lcmparg[None, :]) ^ model.lneg[None, :]
+
+    m_always = jnp.broadcast_to(~model.lneg[None, :], m_str.shape)
+    m_never = jnp.broadcast_to(model.lneg[None, :], m_str.shape)
+
+    lt = model.ltype[None, :]
+    link_m = jnp.select(
+        [lt == LINK_STRING, lt == LINK_NUMERIC, lt == LINK_ALWAYS, lt == LINK_NEVER],
+        [m_str, m_num, m_always, m_never],
+        default=False,
+    )  # counter links False in the prelim pass
+
+    def rules_from_links(lm: jnp.ndarray) -> jnp.ndarray:
+        picked = lm[:, model.link_matrix]  # [B, Rr, MX]
+        picked = jnp.where(model.link_mask[None, :, :], picked, True)
+        return picked.all(axis=-1)  # [B, Rr]
+
+    prelim = rules_from_links(link_m)
+
+    # 4c: anomaly-score counters + threshold links.
+    counters = model.counter_base[None, :] + prelim.astype(jnp.int32) @ model.weights
+    cvals = counters[:, model.lcounter]
+    m_counter = _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :]) ^ model.lneg[None, :]
+    link_m = jnp.where(lt == LINK_COUNTER, m_counter, link_m)
+    matched = rules_from_links(link_m)
+
+    # 5: verdict — first matched decision rule in phase order.
+    in_scope = (model.decision[None, :] != 0) & (model.phase[None, :] <= max_phase)
+    keys = jnp.where(matched & in_scope, model.order_key[None, :], _BIG)
+    first_key = keys.min(axis=1)
+    first_idx = keys.argmin(axis=1)
+    has_decision = first_key < _BIG
+    dec = model.decision[first_idx]
+    interrupts = (dec == DEC_DENY) | (dec == DEC_DROP) | (dec == DEC_REDIRECT)
+    engine_active = model.engine_on and not model.detection_only
+    interrupted = has_decision & interrupts & engine_active
+    status = jnp.where(interrupted, model.status[first_idx], 200)
+    rule_index = jnp.where(has_decision, first_idx, -1)
+
+    return {
+        "matched": matched,  # [B, Rr]
+        "interrupted": interrupted,  # [B]
+        "status": status,  # [B]
+        "rule_index": rule_index,  # [B]
+        "scores": counters,  # [B, C]
+    }

@@ -1,0 +1,13 @@
+"""JAX/Pallas device kernels for the TPU data plane.
+
+- ``transforms`` — vectorized byte-level Seclang transformations over
+  ``[batch, len]`` uint8 tensors.
+- ``dfa`` — the core matcher: blockwise ``lax.scan`` over stacked
+  byte-class DFA tables (two gathers per byte per rule-group).
+- ``pallas`` — hand-written TPU kernels for the hot paths.
+
+All kernels are shape-static and jit-safe: control flow is ``lax.scan``/
+``jnp.where`` only, per the XLA compilation model.
+"""
+
+from .dfa import DFABank, scan_dfa_bank, stack_dfas  # noqa: F401

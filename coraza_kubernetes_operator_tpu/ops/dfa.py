@@ -1,0 +1,126 @@
+"""Stacked-DFA batch scanner — the core matcher kernel.
+
+A bank stacks G compiled DFAs (``compiler/re_dfa.py``) into padded device
+tables and scans a ``[B, L]`` byte batch with ``lax.scan``:
+
+    cls    = classmap[byte]                       # [B, G] gather
+    packed = trans[g, state, cls]                 # [B, G] gather
+    hit    = packed >> 30 ; state = packed & MASK
+
+Two gathers per byte per (row, group). The transition and emit bits are
+packed into one int32 (state index < 2**30) to halve table reads. Long
+bodies stream through the same scan — NFA/DFA state is the natural carry,
+which is the blockwise "long context" decomposition (SURVEY §5): no
+cross-chip sequence parallelism is needed at WAF body sizes, the scan carry
+crosses block boundaries exactly.
+
+Groups are bucketed by table size before stacking (``stack_dfas`` callers
+pad to the bank max), trading padding waste for a single fused kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.re_dfa import DFA
+
+_EMIT_SHIFT = 30
+_STATE_MASK = (1 << _EMIT_SHIFT) - 1
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DFABank:
+    """G stacked DFAs, padded to common [S, C]."""
+
+    packed: jnp.ndarray  # [G, S, C] int32: next_state | (emit << 30)
+    classmap: jnp.ndarray  # [256, G] int32 (transposed for row gather)
+    match_end: jnp.ndarray  # [G, S] bool
+    always: jnp.ndarray  # [G] bool
+
+    def tree_flatten(self):
+        return (self.packed, self.classmap, self.match_end, self.always), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def n_groups(self) -> int:
+        return int(self.packed.shape[0])
+
+    @property
+    def n_states(self) -> int:
+        return int(self.packed.shape[1])
+
+
+def stack_dfas(dfas: list[DFA]) -> DFABank:
+    """Stack DFAs into one padded bank (host-side, numpy)."""
+    g = len(dfas)
+    s_max = max(d.n_states for d in dfas)
+    c_max = max(d.n_classes for d in dfas)
+    packed = np.zeros((g, s_max, c_max), dtype=np.int32)
+    classmap = np.zeros((256, g), dtype=np.int32)
+    match_end = np.zeros((g, s_max), dtype=bool)
+    always = np.zeros(g, dtype=bool)
+    for i, d in enumerate(dfas):
+        s, c = d.n_states, d.n_classes
+        packed[i, :s, :c] = d.trans.astype(np.int32) | (
+            d.emit.astype(np.int32) << _EMIT_SHIFT
+        )
+        classmap[:, i] = d.classmap
+        match_end[i, :s] = d.match_end
+        always[i] = d.always_match
+    return DFABank(
+        packed=jnp.asarray(packed),
+        classmap=jnp.asarray(classmap),
+        match_end=jnp.asarray(match_end),
+        always=jnp.asarray(always),
+    )
+
+
+@partial(jax.jit, static_argnames=())
+def scan_dfa_bank(
+    bank: DFABank, data: jnp.ndarray, lengths: jnp.ndarray
+) -> jnp.ndarray:
+    """Scan ``data`` [B, L] uint8 (zero-padded past ``lengths`` [B]) against
+    every DFA in the bank. Returns ``matched`` [B, G] bool."""
+    b = data.shape[0]
+    g = bank.n_groups
+    garange = jnp.arange(g, dtype=jnp.int32)[None, :]  # [1, G]
+
+    def step(carry, t):
+        state, matched, end_state = carry
+        byte = data[:, t].astype(jnp.int32)  # [B]
+        cls = bank.classmap[byte]  # [B, G]
+        packed = bank.packed[garange, state, cls]  # [B, G]
+        active = (t < lengths)[:, None]  # [B, 1]
+        hit = (packed >> _EMIT_SHIFT).astype(bool)
+        matched = matched | (hit & active)
+        state = jnp.where(active, packed & _STATE_MASK, state)
+        end_state = jnp.where((t == lengths - 1)[:, None], state, end_state)
+        return (state, matched, end_state), None
+
+    # Derive the zero init from the inputs so the carry inherits their
+    # varying-manual-axes property under shard_map (a plain jnp.zeros is
+    # 'unvarying' and lax.scan rejects the carry type mismatch). Both the
+    # data (data-sharded) and the tables (rule-sharded) contribute axes.
+    row0 = (
+        data[:, :1].astype(jnp.int32) * 0 + bank.packed[0, 0, 0] * 0
+    )  # [B, 1] varying zero
+    init = (
+        jnp.zeros((b, g), dtype=jnp.int32) + row0,
+        jnp.zeros((b, g), dtype=bool) | (row0 != 0),
+        jnp.zeros((b, g), dtype=jnp.int32) + row0,
+    )
+    (state, matched, end_state), _ = jax.lax.scan(
+        step, init, jnp.arange(data.shape[1], dtype=jnp.int32)
+    )
+    matched = matched | bank.match_end[garange, end_state]
+    matched = matched | bank.always[None, :]
+    return matched

@@ -1,0 +1,285 @@
+"""Vectorized Seclang transformations over padded byte batches.
+
+Layout convention: ``data`` is ``[N, L]`` uint8, zero-padded past ``lengths``
+(``[N]`` int32). Every transform maps ``(data, lengths) → (data, lengths)``
+with the same static ``L`` (all device transforms are length-preserving or
+contracting; expanding transforms run host-side, see
+``compiler/transforms_host.py``).
+
+Contraction (e.g. ``%41`` → ``A``) uses a stable argsort compaction — an
+O(L log L) fully-vectorized shuffle instead of a sequential copy, which is
+the TPU-friendly formulation. Decode start positions are provably
+non-overlapping (hex digits and entity bodies cannot contain ``%``/``&``),
+so the parallel decode is exactly equivalent to the sequential reference —
+differential-tested in ``tests/test_transforms.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Lookup tables (host constants, closed over by jit)
+# ---------------------------------------------------------------------------
+
+_HEXVAL = np.full(256, -1, dtype=np.int32)
+for _c in b"0123456789":
+    _HEXVAL[_c] = _c - ord("0")
+for _c in b"abcdef":
+    _HEXVAL[_c] = _c - ord("a") + 10
+for _c in b"ABCDEF":
+    _HEXVAL[_c] = _c - ord("A") + 10
+
+_IS_WS = np.zeros(256, dtype=bool)
+for _c in b" \t\n\r\f\v":
+    _IS_WS[_c] = True
+
+_TO_LOWER = np.arange(256, dtype=np.uint8)
+_TO_UPPER = np.arange(256, dtype=np.uint8)
+for _i in range(26):
+    _TO_LOWER[ord("A") + _i] = ord("a") + _i
+    _TO_UPPER[ord("a") + _i] = ord("A") + _i
+
+_DIGITVAL = np.full(256, -1, dtype=np.int32)
+for _c in b"0123456789":
+    _DIGITVAL[_c] = _c - ord("0")
+
+
+def _valid_mask(data: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    idx = jnp.arange(data.shape[1], dtype=jnp.int32)
+    return idx[None, :] < lengths[:, None]
+
+
+def _shift_left(x: jnp.ndarray, k: int, fill=0):
+    """x[:, i] ← x[:, i+k] (reads past the end become ``fill``)."""
+    if k == 0:
+        return x
+    pad = jnp.full((x.shape[0], k), fill, dtype=x.dtype)
+    return jnp.concatenate([x[:, k:], pad], axis=1)
+
+
+def _shift_right(x: jnp.ndarray, k: int, fill=0):
+    """x[:, i] ← x[:, i-k]."""
+    if k == 0:
+        return x
+    pad = jnp.full((x.shape[0], k), fill, dtype=x.dtype)
+    return jnp.concatenate([pad, x[:, : x.shape[1] - k]], axis=1)
+
+
+def compact(data: jnp.ndarray, keep: jnp.ndarray):
+    """Stably move kept bytes to the front of each row; zero-pad the rest.
+
+    Returns (data, new_lengths)."""
+    n, length = data.shape
+    idx = jnp.arange(length, dtype=jnp.int32)
+    keys = jnp.where(keep, idx[None, :], idx[None, :] + length)
+    order = jnp.argsort(keys, axis=1, stable=True)
+    packed = jnp.take_along_axis(data, order, axis=1)
+    new_len = keep.sum(axis=1, dtype=jnp.int32)
+    valid = idx[None, :] < new_len[:, None]
+    return jnp.where(valid, packed, jnp.uint8(0)), new_len
+
+
+# ---------------------------------------------------------------------------
+# Transforms
+# ---------------------------------------------------------------------------
+
+
+def lowercase(data, lengths):
+    return jnp.asarray(_TO_LOWER)[data], lengths
+
+
+def uppercase(data, lengths):
+    return jnp.asarray(_TO_UPPER)[data], lengths
+
+
+def replace_nulls(data, lengths):
+    valid = _valid_mask(data, lengths)
+    return jnp.where(valid & (data == 0), jnp.uint8(0x20), data), lengths
+
+
+def remove_nulls(data, lengths):
+    valid = _valid_mask(data, lengths)
+    return compact(data, valid & (data != 0))
+
+
+def remove_whitespace(data, lengths):
+    valid = _valid_mask(data, lengths)
+    ws = jnp.asarray(_IS_WS)[data]
+    return compact(data, valid & ~ws)
+
+
+def compress_whitespace(data, lengths):
+    valid = _valid_mask(data, lengths)
+    ws = jnp.asarray(_IS_WS)[data] & valid
+    out = jnp.where(ws, jnp.uint8(0x20), data)
+    prev_ws = _shift_right(ws, 1, fill=False)
+    return compact(out, valid & ~(ws & prev_ws))
+
+
+def trim(data, lengths):
+    valid = _valid_mask(data, lengths)
+    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    big = jnp.int32(data.shape[1] + 1)
+    first = jnp.min(jnp.where(non_ws, idx, big), axis=1, keepdims=True)
+    last = jnp.max(jnp.where(non_ws, idx, -1), axis=1, keepdims=True)
+    return compact(data, (idx >= first) & (idx <= last))
+
+
+def trim_left(data, lengths):
+    valid = _valid_mask(data, lengths)
+    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    big = jnp.int32(data.shape[1] + 1)
+    first = jnp.min(jnp.where(non_ws, idx, big), axis=1, keepdims=True)
+    return compact(data, valid & (idx >= first))
+
+
+def trim_right(data, lengths):
+    valid = _valid_mask(data, lengths)
+    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    last = jnp.max(jnp.where(non_ws, idx, -1), axis=1, keepdims=True)
+    return compact(data, valid & (idx <= last))
+
+
+def url_decode(data, lengths, uni: bool = False):
+    """``%XX`` (+ optionally IIS ``%uXXXX``) decode, ``+`` → space.
+
+    Start positions never overlap a decode tail ('%' is not a hex digit and
+    not 'u'), so the parallel formulation matches the sequential oracle."""
+    valid = _valid_mask(data, lengths)
+    hv = jnp.asarray(_HEXVAL)
+    d = [_shift_left(data, k) for k in range(6)]
+    h = [hv[d[k]] for k in range(6)]
+    in_bounds = [
+        _shift_left(valid.astype(jnp.uint8), k).astype(bool) for k in range(6)
+    ]
+
+    is_pct = (data == 0x25) & valid
+    start_u = jnp.zeros_like(is_pct)
+    dec_u = jnp.zeros(data.shape, dtype=jnp.int32)
+    if uni:
+        is_u = (d[1] == 0x75) | (d[1] == 0x55)
+        hex4 = (h[2] >= 0) & (h[3] >= 0) & (h[4] >= 0) & (h[5] >= 0)
+        start_u = is_pct & is_u & hex4 & in_bounds[5]
+        dec_u = (h[4] * 16 + h[5]) & 0xFF  # low byte, matching the host oracle
+
+    start_2 = is_pct & ~start_u & (h[1] >= 0) & (h[2] >= 0) & in_bounds[2]
+    dec_2 = h[1] * 16 + h[2]
+
+    killed = jnp.zeros_like(is_pct)
+    for k in (1, 2):
+        killed |= _shift_right(start_2, k, fill=False)
+    if uni:
+        for k in range(1, 6):
+            killed |= _shift_right(start_u, k, fill=False)
+
+    out = jnp.where(start_u, dec_u.astype(jnp.uint8), data)
+    out = jnp.where(start_2, dec_2.astype(jnp.uint8), out)
+    out = jnp.where((data == 0x2B) & valid, jnp.uint8(0x20), out)
+    return compact(out, valid & ~killed)
+
+
+def url_decode_uni(data, lengths):
+    return url_decode(data, lengths, uni=True)
+
+
+_ENTITY_NAMES = [  # (lowercased name bytes, decoded byte)
+    (b"lt", 0x3C),
+    (b"gt", 0x3E),
+    (b"amp", 0x26),
+    (b"quot", 0x22),
+    (b"nbsp", 0xA0),
+]
+_MAX_ENTITY = 11  # &#xHHHHHHHH; worst case span we scan
+
+
+def html_entity_decode(data, lengths):
+    """Decode ``&#DD;``, ``&#xHH;`` and the named entities ModSecurity
+    supports. Entity bodies can't contain '&', so parallel decode is exact."""
+    valid = _valid_mask(data, lengths)
+    lower = jnp.asarray(_TO_LOWER)[data]
+    d = [_shift_left(data, k) for k in range(_MAX_ENTITY + 1)]
+    dl = [_shift_left(lower, k) for k in range(_MAX_ENTITY + 1)]
+    hv = [jnp.asarray(_HEXVAL)[x] for x in d]
+    dv = [jnp.asarray(_DIGITVAL)[x] for x in d]
+    vb = [_shift_left(valid.astype(jnp.uint8), k).astype(bool) for k in range(_MAX_ENTITY + 1)]
+
+    amp = (data == 0x26) & valid
+    hash_ = d[1] == 0x23
+    is_x = (d[2] == 0x78) | (d[2] == 0x58)
+
+    # span[i] = total entity length at start i (0 = none); value[i] = byte.
+    span = jnp.zeros(data.shape, dtype=jnp.int32)
+    value = jnp.zeros(data.shape, dtype=jnp.int32)
+
+    # Hex entities &#xH{1..7}; — first (longest digit runs checked first so
+    # shorter prefixes with a hex digit where ';' should be don't win.
+    for ndig in range(7, 0, -1):
+        digs = jnp.ones(data.shape, dtype=bool)
+        val = jnp.zeros(data.shape, dtype=jnp.int32)
+        for k in range(ndig):
+            digs &= hv[3 + k] >= 0
+            val = val * 16 + jnp.maximum(hv[3 + k], 0)
+        semi = d[3 + ndig] == 0x3B
+        ok = amp & hash_ & is_x & digs & semi & vb[3 + ndig] & (span == 0)
+        span = jnp.where(ok, 4 + ndig, span)
+        value = jnp.where(ok, val & 0xFF, value)
+
+    # Decimal entities &#D{1..7};
+    for ndig in range(7, 0, -1):
+        digs = jnp.ones(data.shape, dtype=bool)
+        val = jnp.zeros(data.shape, dtype=jnp.int32)
+        for k in range(ndig):
+            digs &= dv[2 + k] >= 0
+            val = val * 10 + jnp.maximum(dv[2 + k], 0)
+        semi = d[2 + ndig] == 0x3B
+        ok = amp & hash_ & ~is_x & digs & semi & vb[2 + ndig] & (span == 0)
+        span = jnp.where(ok, 3 + ndig, span)
+        value = jnp.where(ok, val & 0xFF, value)
+
+    # Named entities (case-insensitive), e.g. &lt;
+    for name, byte in _ENTITY_NAMES:
+        match = jnp.ones(data.shape, dtype=bool)
+        for k, ch in enumerate(name):
+            match &= dl[1 + k] == ch
+        semi = d[1 + len(name)] == 0x3B
+        ok = amp & ~hash_ & match & semi & vb[1 + len(name)] & (span == 0)
+        span = jnp.where(ok, 2 + len(name), span)
+        value = jnp.where(ok, byte, value)
+
+    started = span > 0
+    killed = jnp.zeros_like(amp)
+    for k in range(1, _MAX_ENTITY + 1):
+        killed |= _shift_right(span, k, fill=0) > k
+
+    out = jnp.where(started, value.astype(jnp.uint8), data)
+    return compact(out, valid & ~killed)
+
+
+# Registry of device transforms, keyed by canonical Seclang name. The ruleset
+# compiler checks this to decide device vs host execution of a pipeline.
+DEVICE_TRANSFORMS = {
+    "none": lambda d, l: (d, l),
+    "lowercase": lowercase,
+    "uppercase": uppercase,
+    "urldecode": url_decode,
+    "urldecodeuni": url_decode_uni,
+    "htmlentitydecode": html_entity_decode,
+    "removenulls": remove_nulls,
+    "replacenulls": replace_nulls,
+    "removewhitespace": remove_whitespace,
+    "compresswhitespace": compress_whitespace,
+    "trim": trim,
+    "trimleft": trim_left,
+    "trimright": trim_right,
+}
+
+
+def apply_device_pipeline(data, lengths, transforms: tuple[str, ...]):
+    for name in transforms:
+        data, lengths = DEVICE_TRANSFORMS[name](data, lengths)
+    return data, lengths

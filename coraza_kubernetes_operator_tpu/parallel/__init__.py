@@ -1,0 +1,22 @@
+"""Multi-chip parallelism over ``jax.sharding.Mesh``.
+
+Two axes, matching the workload's natural decomposition (SURVEY §2.3):
+
+- ``data``  — requests are embarrassingly parallel: each data shard
+  evaluates its own sub-batch (the DP analog).
+- ``rule``  — DFA banks are partitioned across chips when tables outgrow
+  one chip's HBM (the TP analog: shard the "feature" dimension, all-gather
+  the per-target hit bits — a tiny activation — over ICI).
+
+The reference has no distributed compute (its only "parallelism" is N
+gateways sharing one RuleSet); this module is the TPU-native scaling path
+that replaces it.
+"""
+
+from .mesh import (  # noqa: F401
+    ShardedWafEngine,
+    ShardedWafModel,
+    build_sharded_model,
+    eval_waf_sharded,
+    make_mesh,
+)

@@ -1,0 +1,274 @@
+"""Sharded WAF evaluation: shard_map over a ('data', 'rule') mesh.
+
+Layout: every DFA bank bucket is split evenly across the rule axis and its
+per-shard tables stacked on a leading shard dimension, so bank leaves are
+uniform arrays shardable with ``PartitionSpec('rule')``. Inside the
+``shard_map`` body each device scans only its bank slice, all-gathers the
+per-target hit bits over the rule axis (the only collective — G bits per
+target, riding ICI), rebuilds the global group-hit matrix and runs the
+shared post-match stages. Targets/requests are stacked on a leading data
+axis with ``PartitionSpec('data')``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..compiler.re_dfa import DFA
+from ..compiler.ruleset import CompiledRuleSet
+from ..models.waf_model import WafModel, build_model, post_match
+from ..ops.dfa import DFABank, scan_dfa_bank, stack_dfas
+from ..ops.transforms import apply_device_pipeline
+
+
+def make_mesh(n_data: int, n_rule: int = 1, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = n_data * n_rule
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_data, n_rule)
+    return Mesh(grid, ("data", "rule"))
+
+
+def _never_dfa() -> DFA:
+    return DFA(
+        trans=np.zeros((1, 1), dtype=np.int32),
+        emit=np.zeros((1, 1), dtype=bool),
+        match_end=np.zeros(1, dtype=bool),
+        classmap=np.zeros(256, dtype=np.int32),
+        always_match=False,
+    )
+
+
+def _stack_shard_banks(shard_banks: list[DFABank]) -> DFABank:
+    """Stack per-shard banks (equal G) onto a leading shard axis, padding
+    S/C to the max across shards."""
+    s_max = max(b.packed.shape[1] for b in shard_banks)
+    c_max = max(b.packed.shape[2] for b in shard_banks)
+    g = shard_banks[0].packed.shape[0]
+
+    def pad(b: DFABank):
+        packed = np.zeros((g, s_max, c_max), dtype=np.int32)
+        p = np.asarray(b.packed)
+        packed[:, : p.shape[1], : p.shape[2]] = p
+        match_end = np.zeros((g, s_max), dtype=bool)
+        match_end[:, : b.match_end.shape[1]] = np.asarray(b.match_end)
+        return packed, np.asarray(b.classmap), match_end, np.asarray(b.always)
+
+    parts = [pad(b) for b in shard_banks]
+    return DFABank(
+        packed=jnp.asarray(np.stack([p[0] for p in parts])),  # [R, G, S, C]
+        classmap=jnp.asarray(np.stack([p[1] for p in parts])),  # [R, 256, G]
+        match_end=jnp.asarray(np.stack([p[2] for p in parts])),  # [R, G, S]
+        always=jnp.asarray(np.stack([p[3] for p in parts])),  # [R, G]
+    )
+
+
+@dataclass
+class ShardedWafModel:
+    """Rule-sharded model: stacked banks + a banks-free post-match model
+    whose ``lgroup`` is remapped to the gathered layout."""
+
+    banks: list[DFABank]  # leaves carry leading [n_rule_shards] axis
+    post: WafModel  # banks == [] — post-match arrays only
+    bank_pipelines: tuple  # pipeline id per bucket bank
+    bucket_widths: tuple  # groups-per-shard per bucket bank
+    pipelines: tuple
+    host_variant_index: tuple
+    n_rule_shards: int = 1
+
+
+def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafModel:
+    base = build_model(crs)  # reuse bucketing/arrays; we re-stack the banks
+
+    # Re-bucket the groups exactly like build_model, but split each bucket
+    # across rule shards with never-match padding.
+    from ..models.waf_model import _STATE_BUCKETS
+
+    buckets: dict[tuple[int, int], list[int]] = {}
+    for gid, grp in enumerate(crs.groups):
+        s = grp.dfa.n_states
+        bucket = next(b for b in _STATE_BUCKETS if s <= b)
+        buckets.setdefault((crs.group_pipeline[gid], bucket), []).append(gid)
+
+    banks: list[DFABank] = []
+    bank_pipelines: list[int] = []
+    bucket_widths: list[int] = []
+    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
+    offset = 0
+    for (pid, _bucket), gids in sorted(buckets.items()):
+        width = max(1, math.ceil(len(gids) / n_rule_shards))
+        shard_banks = []
+        for s in range(n_rule_shards):
+            chunk = gids[s * width : (s + 1) * width]
+            dfas = [crs.groups[g].dfa for g in chunk]
+            dfas += [_never_dfa()] * (width - len(dfas))
+            for j, g in enumerate(chunk):
+                # Gathered layout: bucket-major, then shard, then slot.
+                remap[g] = offset + s * width + j
+            shard_banks.append(stack_dfas(dfas))
+        banks.append(_stack_shard_banks(shard_banks))
+        bank_pipelines.append(pid)
+        bucket_widths.append(width)
+        offset += n_rule_shards * width
+
+    # lgroup in the ORIGINAL compiled link order, remapped to gathered ids.
+    lgroup = np.zeros(int(base.lgroup.shape[0]), dtype=np.int32)
+    for i, link in enumerate(crs.links):
+        lgroup[i] = remap[link.group] if link.group >= 0 else 0
+
+    post = WafModel(
+        banks=[],
+        ltype=base.ltype,
+        lneg=base.lneg,
+        lgroup=jnp.asarray(lgroup),
+        lnumvar=base.lnumvar,
+        lcmp=base.lcmp,
+        lcmparg=base.lcmparg,
+        lcounter=base.lcounter,
+        inc=base.inc,
+        exc=base.exc,
+        link_matrix=base.link_matrix,
+        link_mask=base.link_mask,
+        decision=base.decision,
+        status=base.status,
+        order_key=base.order_key,
+        phase=base.phase,
+        weights=base.weights,
+        counter_base=base.counter_base,
+        bank_pipelines=(),
+        pipelines=base.pipelines,
+        pipeline_device=base.pipeline_device,
+        host_variant_index=base.host_variant_index,
+        engine_on=base.engine_on,
+        detection_only=base.detection_only,
+    )
+
+    return ShardedWafModel(
+        banks=banks,
+        post=post,
+        bank_pipelines=tuple(bank_pipelines),
+        bucket_widths=tuple(bucket_widths),
+        pipelines=base.pipelines,
+        host_variant_index=base.host_variant_index,
+        n_rule_shards=n_rule_shards,
+    )
+
+
+def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
+    """Evaluate stacked per-data-shard tensors over the mesh.
+
+    ``tensors`` leaves carry a leading [n_data] axis; bank leaves carry a
+    leading [n_rule] axis. Output leaves carry [n_data]."""
+    n_rule = model.n_rule_shards
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("rule"), P(), P("data")),
+        out_specs=P("data"),
+    )
+    def run(banks, post, shard_tensors):
+        banks = jax.tree.map(lambda x: x[0], banks)  # squeeze rule block
+        (data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths) = jax.tree.map(
+            lambda x: x[0], shard_tensors
+        )  # squeeze data block
+        per_bucket = []
+        transformed = {}
+        for bank, pid in zip(banks, model.bank_pipelines):
+            if pid not in transformed:
+                slot = model.host_variant_index[pid]
+                if slot >= 0:
+                    transformed[pid] = (vdata[slot], vlengths[slot])
+                else:
+                    transformed[pid] = apply_device_pipeline(
+                        data, lengths, model.pipelines[pid]
+                    )
+            per_bucket.append(scan_dfa_bank(bank, *transformed[pid]))
+        sub = jnp.concatenate(per_bucket, axis=1)  # [T, sum(width)]
+        # The one collective: per-target hit bits across rule shards (ICI).
+        gathered = jax.lax.all_gather(sub, "rule")  # [R, T, W]
+        t = sub.shape[0]
+        cols = []
+        o = 0
+        for width in model.bucket_widths:
+            blk = gathered[:, :, o : o + width]  # [R, T, w]
+            cols.append(jnp.moveaxis(blk, 0, 1).reshape(t, n_rule * width))
+            o += width
+        group_hits = jnp.concatenate(cols, axis=1)  # [T, G_gathered]
+        out = post_match(post, group_hits, k1, k2, k3, req_id, numvals)
+        # Post-gather values are identical on every rule shard; an idempotent
+        # pmax makes that replication explicit to the vma type system.
+        out = jax.tree.map(
+            lambda x: jax.lax.pmax(x.astype(jnp.int32), "rule").astype(x.dtype), out
+        )
+        return jax.tree.map(lambda x: x[None], out)  # restore data axis
+
+    return run(model.banks, model.post, tensors)
+
+
+@dataclass
+class ShardedWafEngine:
+    """Facade: WafEngine semantics over a device mesh."""
+
+    compiled: CompiledRuleSet
+    mesh: Mesh
+    model: ShardedWafModel = field(init=False)
+
+    def __post_init__(self):
+        from ..engine.waf import WafEngine
+
+        self.model = build_sharded_model(
+            self.compiled, self.mesh.shape["rule"]
+        )
+        self._single = WafEngine(self.compiled)  # reuses extractor/tensorize
+
+    def evaluate(self, requests):
+        """Shard requests over the data axis, evaluate, reassemble verdicts
+        in input order."""
+        d = self.mesh.shape["data"]
+        shards = [requests[i::d] for i in range(d)]
+        extractions = [
+            [self._single.extractor.extract(r) for r in shard] for shard in shards
+        ]
+        per_shard = [self._single._tensorize(ex) for ex in extractions]
+        # Pad every shard's tensors to common shapes, then stack on axis 0.
+        stacked = []
+        for leaf_idx in range(len(per_shard[0])):
+            leaves = [np.asarray(ts[leaf_idx]) for ts in per_shard]
+            shape = tuple(max(l.shape[i] for l in leaves) for i in range(leaves[0].ndim))
+            padded = []
+            for ts, leaf in zip(per_shard, leaves):
+                pad = [(0, s - ls) for s, ls in zip(shape, leaf.shape)]
+                if leaf_idx == 5:  # req_id: pad rows must stay out-of-range
+                    n_req = np.asarray(ts[6]).shape[0]
+                    padded.append(
+                        np.pad(leaf, pad, constant_values=n_req)
+                    )
+                else:
+                    padded.append(np.pad(leaf, pad))
+            stacked.append(jnp.asarray(np.stack(padded)))
+        out = eval_waf_sharded(self.mesh, self.model, tuple(stacked))
+        interrupted = np.asarray(out["interrupted"])
+        status = np.asarray(out["status"])
+        rule_index = np.asarray(out["rule_index"])
+
+        from ..engine.waf import Verdict
+
+        verdicts: list[Verdict | None] = [None] * len(requests)
+        for s, shard in enumerate(shards):
+            for j, _req in enumerate(shard):
+                ridx = int(rule_index[s, j])
+                verdicts[s + j * d] = Verdict(
+                    interrupted=bool(interrupted[s, j]),
+                    status=int(status[s, j]),
+                    rule_id=int(self._single._rule_ids[ridx]) if ridx >= 0 else None,
+                )
+        return verdicts

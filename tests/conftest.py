@@ -1,0 +1,25 @@
+"""Test bootstrap: run JAX on a virtual 8-device CPU mesh.
+
+This is the envtest analog from the reference test strategy (reference
+``internal/controller/suite_test.go`` boots a real kube-apiserver without a
+cluster): we boot JAX with 8 virtual CPU devices so all sharding/mesh code
+paths compile and execute without TPU hardware.
+
+Note: the image's sitecustomize latches ``JAX_PLATFORMS=axon`` (the real
+TPU tunnel) before test code runs, so an env setdefault is too late —
+``jax.config.update`` is the reliable override; the XLA_FLAGS append still
+works because the CPU backend initializes lazily.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,71 @@
+"""Cache unit tests — tier-1 of the reference test strategy
+(``internal/rulesets/cache/cache_test.go``): put/get, UUID rotation,
+age/size pruning with the never-evict-latest invariant, using the
+timestamp test hook instead of sleeping."""
+
+from datetime import datetime, timedelta, timezone
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache
+
+
+def test_put_get_roundtrip():
+    cache = RuleSetCache()
+    assert cache.get("default/rs") is None
+    cache.put("default/rs", "SecRuleEngine On")
+    entry = cache.get("default/rs")
+    assert entry is not None
+    assert entry.rules == "SecRuleEngine On"
+    assert entry.uuid
+
+
+def test_uuid_rotates_on_update():
+    cache = RuleSetCache()
+    first = cache.put("ns/rs", "v1")
+    second = cache.put("ns/rs", "v2")
+    assert first.uuid != second.uuid
+    assert cache.get("ns/rs").rules == "v2"
+    assert cache.count_entries("ns/rs") == 2
+
+
+def test_list_keys_and_total_size():
+    cache = RuleSetCache()
+    cache.put("a/x", "12345")
+    cache.put("b/y", "123")
+    assert sorted(cache.list_keys()) == ["a/x", "b/y"]
+    assert cache.total_size() == 8
+
+
+def test_prune_by_age_never_evicts_latest():
+    cache = RuleSetCache()
+    cache.put("ns/rs", "old")
+    cache.put("ns/rs", "new")
+    ancient = datetime.now(timezone.utc) - timedelta(days=2)
+    cache.set_entry_timestamp("ns/rs", 0, ancient)
+    assert cache.prune(timedelta(hours=24)) == 1
+    assert cache.count_entries("ns/rs") == 1
+    assert cache.get("ns/rs").rules == "new"
+
+    # Even an ancient latest entry survives.
+    cache.set_entry_timestamp("ns/rs", 0, ancient)
+    assert cache.prune(timedelta(hours=24)) == 0
+    assert cache.get("ns/rs").rules == "new"
+
+
+def test_prune_by_size_oldest_first_never_latest():
+    cache = RuleSetCache()
+    cache.put("ns/rs", "a" * 100)
+    cache.put("ns/rs", "b" * 100)
+    cache.put("ns/rs", "c" * 100)
+    pruned = cache.prune_by_size(150)
+    assert pruned == 2
+    assert cache.get("ns/rs").rules == "c" * 100
+    # Latest alone over budget: nothing to prune, size stays over.
+    assert cache.prune_by_size(50) == 0
+    assert cache.total_size() == 100
+
+
+def test_prune_by_size_under_budget_noop():
+    cache = RuleSetCache()
+    cache.put("ns/rs", "aaa")
+    assert cache.prune_by_size(1000) == 0
+    assert cache.count_entries("ns/rs") == 1

@@ -1,0 +1,114 @@
+"""Cache HTTP server tests — routing, JSON shapes, GC loop with
+millisecond intervals (mirrors ``internal/rulesets/cache/server_test.go``,
+which drives handlers plus the real GC goroutine)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import (
+    GarbageCollectionConfig,
+    RuleSetCache,
+    RuleSetCacheServer,
+)
+
+
+@pytest.fixture()
+def server():
+    cache = RuleSetCache()
+    srv = RuleSetCacheServer(
+        cache,
+        host="127.0.0.1",
+        port=0,
+        gc=GarbageCollectionConfig(gc_interval=timedelta(milliseconds=20)),
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}", timeout=5)
+
+
+def test_get_rules_full_entry(server):
+    server.cache.put("default/my-ruleset", "SecRuleEngine On")
+    with _get(server, "/rules/default/my-ruleset") as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "application/json"
+        body = json.loads(resp.read())
+    assert set(body) == {"uuid", "timestamp", "rules"}
+    assert body["rules"] == "SecRuleEngine On"
+    assert body["timestamp"].endswith("Z")
+
+
+def test_get_latest_metadata_only(server):
+    entry = server.cache.put("default/my-ruleset", "SecRuleEngine On")
+    with _get(server, "/rules/default/my-ruleset/latest") as resp:
+        body = json.loads(resp.read())
+    assert body == {
+        "uuid": entry.uuid,
+        "timestamp": body["timestamp"],
+    }
+    assert "rules" not in body
+
+
+def test_latest_uuid_changes_after_put(server):
+    server.cache.put("ns/rs", "v1")
+    with _get(server, "/rules/ns/rs/latest") as resp:
+        first = json.loads(resp.read())["uuid"]
+    server.cache.put("ns/rs", "v2")
+    with _get(server, "/rules/ns/rs/latest") as resp:
+        second = json.loads(resp.read())["uuid"]
+    assert first != second
+
+
+def test_not_found(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/rules/missing/key")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/rules/missing/key/latest")
+    assert e.value.code == 404
+
+
+def test_empty_key_bad_request(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/rules/")
+    assert e.value.code == 400
+
+
+def test_method_not_allowed(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/rules/a/b", data=b"x", method="POST"
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 405
+
+
+def test_gc_prunes_by_age_keeps_latest(server):
+    server.cache.put("ns/rs", "old")
+    server.cache.put("ns/rs", "new")
+    ancient = datetime.now(timezone.utc) - timedelta(days=2)
+    server.cache.set_entry_timestamp("ns/rs", 0, ancient)
+    deadline = time.time() + 2
+    while server.cache.count_entries("ns/rs") > 1 and time.time() < deadline:
+        time.sleep(0.02)
+    assert server.cache.count_entries("ns/rs") == 1
+    assert server.cache.get("ns/rs").rules == "new"
+
+
+def test_gc_prunes_by_size(server):
+    server.gc.max_size = 150
+    server.cache.put("ns/rs", "a" * 100)
+    server.cache.put("ns/rs", "b" * 100)
+    deadline = time.time() + 2
+    while server.cache.total_size() > 150 and time.time() < deadline:
+        time.sleep(0.02)
+    assert server.cache.total_size() == 100
+    assert server.cache.get("ns/rs").rules == "b" * 100

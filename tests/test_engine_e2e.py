@@ -1,0 +1,297 @@
+"""End-to-end engine tests: Seclang text → compile → device eval → verdict.
+
+Rule corpus mirrors the reference samples (``config/samples/ruleset.yaml``,
+``test/integration/coreruleset_test.go``) plus CRS-style anomaly scoring.
+Assertion style follows the reference traffic helpers: blocked means 403
+exactly, allowed means 200 exactly (``test/framework/traffic.go:109-120``).
+"""
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,auditlog,deny,status:403"
+"""
+
+EVIL_MONKEY = r"""
+SecRule ARGS|REQUEST_URI|REQUEST_HEADERS "@contains evilmonkey" \
+  "id:3001,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'Evil Monkey Detected'"
+"""
+
+SQLI = r"""
+SecRule ARGS "@rx (?i:(\b(select|union|insert|update|delete|drop)\b.*\b(from|into|where|table)\b))" \
+  "id:942100,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'SQL Injection Attack Detected',severity:'CRITICAL'"
+"""
+
+XSS = r"""
+SecRule ARGS "@rx (?i:<script[^>]*>)" \
+  "id:941100,phase:2,deny,status:403,t:none,t:urlDecodeUni,t:htmlEntityDecode,msg:'XSS Attack Detected'"
+"""
+
+
+def _get(uri, headers=None, body=b"", method="GET"):
+    return HttpRequest(method=method, uri=uri, headers=headers or [], body=body)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WafEngine(BASE + EVIL_MONKEY + SQLI + XSS)
+
+
+def test_clean_request_allowed(engine):
+    v = engine.evaluate_one(_get("/index.html?q=hello"))
+    assert v.allowed and v.status == 200 and v.matched_ids == []
+
+
+def test_contains_in_uri_blocked(engine):
+    v = engine.evaluate_one(_get("/evilmonkey/path"))
+    assert v.interrupted and v.status == 403 and v.rule_id == 3001
+
+
+def test_contains_in_arg_blocked(engine):
+    v = engine.evaluate_one(_get("/?pet=evilmonkey"))
+    assert v.interrupted and v.rule_id == 3001
+
+
+def test_contains_in_header_blocked(engine):
+    v = engine.evaluate_one(_get("/", headers=[("User-Agent", "evilmonkey-bot")]))
+    assert v.interrupted
+
+
+def test_contains_urldecoded_blocked(engine):
+    # %65 = 'e' — only visible after t:urlDecodeUni.
+    v = engine.evaluate_one(_get("/?pet=%65vilmonkey"))
+    assert v.interrupted and v.rule_id == 3001
+
+
+def test_sqli_blocked(engine):
+    v = engine.evaluate_one(_get("/?q=SELECT+name+FROM+users"))
+    assert v.interrupted and v.rule_id == 942100
+
+
+def test_sqli_wordboundary_not_overblocking(engine):
+    v = engine.evaluate_one(_get("/?q=selections+fromage"))
+    assert v.allowed
+
+
+def test_xss_html_entity_blocked(engine):
+    v = engine.evaluate_one(_get("/?x=%26lt%3Bscript%26gt%3Balert(1)"))
+    assert v.interrupted and v.rule_id == 941100
+
+
+def test_post_body_args(engine):
+    v = engine.evaluate_one(
+        _get(
+            "/login",
+            method="POST",
+            headers=[("Content-Type", "application/x-www-form-urlencoded")],
+            body=b"user=admin&q=union%20select%20a%20from%20b",
+        )
+    )
+    assert v.interrupted and v.rule_id == 942100
+
+
+def test_json_body_args(engine):
+    v = engine.evaluate_one(
+        _get(
+            "/api",
+            method="POST",
+            headers=[("Content-Type", "application/json")],
+            body=b'{"query": "drop table users; select x from y"}',
+        )
+    )
+    assert v.interrupted and v.rule_id == 942100
+
+
+def test_batch_mixed_verdicts(engine):
+    reqs = [
+        _get("/ok?a=1"),
+        _get("/?pet=evilmonkey"),
+        _get("/fine"),
+        _get("/?q=union select x from y"),
+    ]
+    verdicts = engine.evaluate(reqs)
+    assert [v.interrupted for v in verdicts] == [False, True, False, True]
+    assert verdicts[1].rule_id == 3001
+    assert verdicts[3].rule_id == 942100
+
+
+def test_detection_only_mode():
+    rules = BASE.replace("SecRuleEngine On", "SecRuleEngine DetectionOnly") + EVIL_MONKEY
+    eng = WafEngine(rules)
+    v = eng.evaluate_one(_get("/evilmonkey"))
+    assert v.allowed and 3001 in v.matched_ids
+
+
+def test_engine_off_mode():
+    rules = BASE.replace("SecRuleEngine On", "SecRuleEngine Off") + EVIL_MONKEY
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/evilmonkey")).allowed
+
+
+def test_header_selector_rule():
+    rules = BASE + (
+        'SecRule REQUEST_HEADERS:Content-Type "@contains xml" '
+        '"id:10,phase:1,deny,status:415,t:lowercase"'
+    )
+    eng = WafEngine(rules)
+    blocked = eng.evaluate_one(_get("/", headers=[("Content-Type", "application/XML")]))
+    assert blocked.interrupted and blocked.status == 415
+    ok = eng.evaluate_one(_get("/", headers=[("Content-Type", "application/json"), ("X-Other", "xml")]))
+    assert ok.allowed  # other headers must not feed the selector
+
+
+def test_negated_numeric_reqbody_error():
+    rules = BASE + (
+        'SecRule REQBODY_ERROR "!@eq 0" '
+        '"id:200002,phase:2,deny,status:400,msg:\'Failed to parse request body.\'"'
+    )
+    eng = WafEngine(rules)
+    bad = eng.evaluate_one(
+        _get("/", method="POST", headers=[("Content-Type", "application/json")], body=b"{oops")
+    )
+    assert bad.interrupted and bad.status == 400
+    good = eng.evaluate_one(
+        _get("/", method="POST", headers=[("Content-Type", "application/json")], body=b'{"a":1}')
+    )
+    assert good.allowed
+
+
+def test_block_resolves_via_default_action():
+    rules = BASE + (
+        'SecRule ARGS "@contains attackme" "id:77,phase:2,block,t:none"'
+    )
+    eng = WafEngine(rules)
+    v = eng.evaluate_one(_get("/?a=attackme"))
+    assert v.interrupted and v.status == 403 and v.rule_id == 77
+
+
+def test_anomaly_scoring_threshold():
+    rules = BASE + r"""
+SecAction "id:900110,phase:1,pass,nolog,setvar:tx.inbound_anomaly_score_threshold=10,setvar:tx.critical_anomaly_score=5"
+SecRule ARGS "@contains attack1" "id:101,phase:2,pass,t:none,setvar:tx.inbound_anomaly_score_pl1=+%{tx.critical_anomaly_score}"
+SecRule ARGS "@contains attack2" "id:102,phase:2,pass,t:none,setvar:tx.inbound_anomaly_score_pl1=+%{tx.critical_anomaly_score}"
+SecRule TX:INBOUND_ANOMALY_SCORE_PL1 "@ge %{tx.inbound_anomaly_score_threshold}" \
+  "id:949110,phase:2,deny,status:403,t:none,msg:'Inbound Anomaly Score Exceeded'"
+"""
+    eng = WafEngine(rules)
+    one = eng.evaluate_one(_get("/?a=attack1"))
+    assert one.allowed and one.scores["inbound_anomaly_score_pl1"] == 5
+    both = eng.evaluate_one(_get("/?a=attack1&b=attack2"))
+    assert both.interrupted and both.rule_id == 949110
+    assert both.scores["inbound_anomaly_score_pl1"] == 10
+
+
+def test_paranoia_gate_const_elimination():
+    rules = BASE + r"""
+SecAction "id:900000,phase:1,pass,nolog,setvar:tx.detection_paranoia_level=1"
+SecRule TX:DETECTION_PARANOIA_LEVEL "@lt 2" "id:911011,phase:1,pass,nolog,skipAfter:END-PL2"
+SecRule ARGS "@contains pl2only" "id:920200,phase:2,deny,status:403,t:none"
+SecMarker "END-PL2"
+SecRule ARGS "@contains always" "id:920300,phase:2,deny,status:403,t:none"
+"""
+    eng = WafEngine(rules)
+    # PL2 rule skipped at compile time: no block.
+    assert eng.evaluate_one(_get("/?a=pl2only")).allowed
+    assert eng.evaluate_one(_get("/?a=always")).interrupted
+    assert eng.compiled.report.const_eliminated >= 2
+
+
+def test_count_variable():
+    rules = BASE + 'SecRule &ARGS "@gt 3" "id:55,phase:2,deny,status:403,t:none"'
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/?a=1&b=2&c=3")).allowed
+    assert eng.evaluate_one(_get("/?a=1&b=2&c=3&d=4")).interrupted
+
+
+def test_arg_exclusion():
+    rules = BASE + (
+        'SecRule ARGS|!ARGS:trusted "@contains secret" "id:66,phase:2,deny,status:403,t:none"'
+    )
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/?trusted=secret")).allowed
+    assert eng.evaluate_one(_get("/?other=secret")).interrupted
+
+
+def test_chain_rule():
+    rules = BASE + r"""
+SecRule REQUEST_METHOD "@streq POST" "id:88,phase:2,deny,status:403,t:none,chain"
+SecRule REQUEST_URI "@contains /admin" "t:lowercase"
+"""
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/admin", method="GET")).allowed
+    assert eng.evaluate_one(_get("/other", method="POST")).allowed
+    assert eng.evaluate_one(_get("/ADMIN/panel", method="POST")).interrupted
+
+
+def test_rule_remove_by_id():
+    rules = BASE + EVIL_MONKEY + "\nSecRuleRemoveById 3001\n"
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/evilmonkey")).allowed
+
+
+def test_overlapping_regex_selectors_both_visible():
+    # Review finding: a target name matching two regex selectors must be
+    # visible to both rules (overflow kind rows).
+    rules = BASE + r"""
+SecRule ARGS:/^aa/ "@contains evil1" "id:201,phase:2,deny,status:403,t:none"
+SecRule ARGS:/aa$/ "@contains evil2" "id:202,phase:2,deny,status:403,t:none"
+"""
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/?aa=evil2")).rule_id == 202
+    assert eng.evaluate_one(_get("/?aa=evil1")).rule_id == 201
+    assert eng.evaluate_one(_get("/?aa=clean")).allowed
+
+
+def test_macro_args_not_deduped_to_one_dfa():
+    rules = BASE + r"""
+SecAction "id:1,phase:1,pass,nolog,setvar:tx.x=evilA"
+SecRule ARGS "@contains %{tx.x}" "id:2,phase:2,deny,status:403,t:none"
+SecAction "id:3,phase:1,pass,nolog,setvar:tx.x=evilB"
+SecRule ARGS "@contains %{tx.x}" "id:4,phase:2,deny,status:403,t:none"
+"""
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/?q=evilA")).rule_id == 2
+    assert eng.evaluate_one(_get("/?q=evilB")).rule_id == 4
+
+
+def test_default_action_disruptive_inherited():
+    # A rule with no disruptive action inherits SecDefaultAction's deny.
+    rules = BASE + 'SecRule ARGS "@contains evil" "id:10,phase:2,t:none"'
+    eng = WafEngine(rules)
+    v = eng.evaluate_one(_get("/?q=evil"))
+    assert v.interrupted and v.status == 403
+
+
+def test_plain_selector_with_slash_keeps_variable_list():
+    rules = BASE + (
+        'SecRule ARGS:a/b|REQUEST_URI "@contains evil" "id:11,phase:2,deny,status:403,t:none"'
+    )
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/evil-path")).interrupted  # REQUEST_URI survived the split
+
+
+def test_empty_ruleset_no_phantom_match():
+    eng = WafEngine("SecRuleEngine On")
+    v = eng.evaluate_one(_get("/?q=x"))
+    assert v.allowed and v.matched_ids == []
+
+
+def test_invalid_regex_is_hard_error():
+    # Validation contract parity: coraza.NewWAF rejects invalid patterns and
+    # the controller marks the RuleSet Degraded — skipping silently would
+    # fail open (reference ruleset_controller.go:158-171).
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import CompileError
+
+    with pytest.raises(CompileError):
+        WafEngine(BASE + 'SecRule ARGS "@rx (unclosed" "id:2,phase:1,pass"')
+
+
+def test_pm_operator():
+    rules = BASE + 'SecRule ARGS "@pm sleep benchmark waitfor" "id:44,phase:2,deny,status:403,t:none"'
+    eng = WafEngine(rules)
+    assert eng.evaluate_one(_get("/?q=SLEEP(5)")).interrupted
+    assert eng.evaluate_one(_get("/?q=awake")).allowed

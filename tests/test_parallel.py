@@ -1,0 +1,64 @@
+"""Sharded evaluation vs single-device reference on the virtual CPU mesh."""
+
+import jax
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.parallel import ShardedWafEngine, make_mesh
+
+RULES = r"""
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,auditlog,deny,status:403"
+SecRule ARGS "@rx (?i:(\b(select|union|insert|update|delete|drop)\b.*\b(from|into|where|table)\b))" \
+  "id:942100,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'SQLi'"
+SecRule ARGS "@rx (?i:<script[^>]*>)" \
+  "id:941100,phase:2,deny,status:403,t:none,t:urlDecodeUni,t:htmlEntityDecode,msg:'XSS'"
+SecRule ARGS|REQUEST_URI|REQUEST_HEADERS "@contains evilmonkey" \
+  "id:3001,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'Monkey'"
+SecRule ARGS "@pm sleep benchmark waitfor" "id:44,phase:2,deny,status:403,t:none,t:lowercase"
+SecRule REQUEST_URI "@beginsWith /blocked" "id:45,phase:1,deny,status:403,t:none"
+"""
+
+REQUESTS = [
+    HttpRequest(uri="/ok?q=hello"),
+    HttpRequest(uri="/?q=union+select+a+from+b"),
+    HttpRequest(uri="/?x=%3Cscript%3E"),
+    HttpRequest(uri="/", headers=[("UA", "evilmonkey")]),
+    HttpRequest(uri="/?q=SLEEP(9)"),
+    HttpRequest(uri="/blocked/path"),
+    HttpRequest(uri="/fine/path?a=1&b=2"),
+    HttpRequest(
+        method="POST",
+        uri="/api",
+        headers=[("Content-Type", "application/json")],
+        body=b'{"q": "drop table x; select 1 from t"}',
+    ),
+    HttpRequest(uri="/also-ok"),
+    HttpRequest(uri="/?deep=%26lt%3Bscript%26gt%3B"),
+]
+
+
+@pytest.mark.parametrize("shape", [(2, 1), (4, 2), (2, 4)])
+def test_sharded_matches_single(shape):
+    n_data, n_rule = shape
+    if len(jax.devices()) < n_data * n_rule:
+        pytest.skip("not enough devices")
+    compiled = compile_rules(RULES)
+    single = WafEngine(compiled)
+    expected = single.evaluate(REQUESTS)
+
+    mesh = make_mesh(n_data, n_rule)
+    sharded = ShardedWafEngine(compiled=compiled, mesh=mesh)
+    got = sharded.evaluate(REQUESTS)
+
+    for i, (e, g) in enumerate(zip(expected, got)):
+        assert g.interrupted == e.interrupted, (i, REQUESTS[i].uri)
+        assert g.status == e.status, (i, REQUESTS[i].uri)
+        assert g.rule_id == e.rule_id, (i, REQUESTS[i].uri)
+
+
+def test_mesh_device_requirements():
+    with pytest.raises(ValueError):
+        make_mesh(1000, 1000)

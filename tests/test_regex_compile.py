@@ -1,0 +1,238 @@
+"""Differential tests: our NFA/DFA vs Python ``re`` as the oracle.
+
+The kernel-vs-reference-regex differential strategy is the TPU analog of the
+reference's envtest tier (SURVEY §4): pure compiler correctness on CPU,
+no hardware needed. Patterns mirror the shapes in the reference corpus
+(``config/samples/ruleset.yaml`` SQLi/XSS rules, CRS-style idioms).
+"""
+
+import random
+import re
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler import (
+    RegexParseError,
+    compile_regex_dfa,
+    literal_dfa,
+    parse_regex,
+    pm_dfa,
+)
+from coraza_kubernetes_operator_tpu.compiler.re_nfa import build_position_nfa
+
+PATTERNS = [
+    "abc",
+    "a.c",
+    "(?i)hello",
+    "(?i:select|union|insert)",
+    r"\bselect\b",
+    r"(?i:(\b(select|union|insert|update|delete|drop)\b.*\b(from|into|where|table)\b))",
+    r"<script[^>]*>",
+    "^application/json",
+    r"on(error|load)\s*=",
+    "a{2,4}b",
+    r"[0-9]{1,3}(\.[0-9]{1,3}){3}",
+    "colou?r",
+    "(foo|bar)+baz",
+    "^/admin",
+    "passwd$",
+    r"\d+\s*=\s*\d+",
+    "['\"].*or.*['\"]",
+    "javascript:",
+    "(?i)<iframe",
+    r"\w+@\w+\.\w+",
+    r"union(\s|\+)+select",
+    "[^a-z]+z",
+    "(?s)a.b",
+    r"\bor\b\s*['\"]?\d+['\"]?\s*=\s*['\"]?\d+",
+    r"(?i)(onerror|onload)\s*=",
+    r"\.\./",
+    r"^[a-zA-Z0-9_-]+$",
+    r"%3[cC]script",
+    r"(?i:\b(?:and|or)\b\s+\d{1,10}\s*[=<>])",
+    r"etc/+passwd",
+    r"\x3cscript",
+    r"(select){2,}",
+    r"a\b\w",
+    r"x|y|z{0,2}w",
+]
+
+ALWAYS_MATCH = ["a*", "x?", "(a|b)*"]
+
+CORPUS = [
+    b"",
+    b"a",
+    b"abc",
+    b"xabcx",
+    b"select * from users",
+    b"SELECT name FROM table WHERE id=1",
+    b"1 OR '1'='1'",
+    b"or 1=1",
+    b"<script>alert(1)</script>",
+    b"<SCRIPT src=x>",
+    b"javascript:alert(1)",
+    b"onerror =x",
+    b"application/json",
+    b"text/application/json",
+    b"/admin/login",
+    b"x/admin",
+    b"/etc/passwd",
+    b"/etc//passwd",
+    b"aab",
+    b"aaab",
+    b"aaaaab",
+    b"colour color",
+    b"foobarbaz",
+    b"192.168.0.1",
+    b"user@example.com",
+    b"union  select",
+    b"union+select",
+    b"UNION/**/SELECT",
+    b"a\nb",
+    b"line1\nline2",
+    b"selections",  # 'select' inside a word — \b must reject
+    b"the select here",
+    b"drop table users;",
+    b"%3cscript%3e",
+    b"\x3cscript",
+    b"selectselect",
+    b"xyzzy",
+    b"..//..//etc/passwd",
+    b"ABC123",
+    b"hello world",
+    b"HELLO",
+]
+
+
+def _oracle(pattern: str):
+    # Python re's $ also matches before a trailing newline; RE2's does not.
+    # Translate to \Z for end-of-text semantics (no $ inside classes in corpus).
+    translated = pattern.replace("$", r"\Z")
+    return re.compile(translated.encode("latin-1"))
+
+
+def _random_inputs(rng, pattern: str, n=150):
+    alphabet = sorted(set(pattern.encode("latin-1")) | set(b"abcxyz01 ='<>/\n."))
+    out = []
+    for _ in range(n):
+        length = rng.randrange(0, 40)
+        out.append(bytes(rng.choice(alphabet) for _ in range(length)))
+    return out
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_dfa_matches_re(pattern):
+    rng = random.Random(hash(pattern) & 0xFFFFFFFF)
+    oracle = _oracle(pattern)
+    dfa = compile_regex_dfa(pattern)
+    nfa = build_position_nfa(parse_regex(pattern))
+    for data in CORPUS + _random_inputs(rng, pattern):
+        expected = oracle.search(data) is not None
+        assert nfa.search(data) == expected, (pattern, data, "nfa")
+        assert dfa.search(data) == expected, (pattern, data, "dfa")
+
+
+@pytest.mark.parametrize("pattern", ALWAYS_MATCH)
+def test_always_match_patterns(pattern):
+    dfa = compile_regex_dfa(pattern)
+    assert dfa.always_match
+    assert dfa.search(b"") and dfa.search(b"qqq")
+
+
+def test_case_insensitive_flag_argument():
+    dfa = compile_regex_dfa("select", case_insensitive=True)
+    assert dfa.search(b"SeLeCt 1")
+    assert not dfa.search(b"selec")
+
+
+def test_empty_anchored_pattern():
+    dfa = compile_regex_dfa("^$")
+    assert dfa.search(b"")
+    assert not dfa.search(b"x")
+
+
+def test_word_boundary_at_edges():
+    dfa = compile_regex_dfa(r"\bor\b")
+    assert dfa.search(b"or")
+    assert dfa.search(b"x or y")
+    assert not dfa.search(b"for")
+    assert not dfa.search(b"ore")
+
+
+def test_multiline_flag():
+    dfa = compile_regex_dfa(r"(?m)^admin")
+    assert dfa.search(b"user\nadmin")
+    assert not dfa.search(b"user admin")
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "a(?=b)",
+        "a(?!b)",
+        "(?<=a)b",
+        "(a)\\1",
+        "a{3,2}",
+        "[z-a]",
+        "(unclosed",
+        "a{2000}",
+    ],
+)
+def test_rejected_patterns(bad):
+    with pytest.raises(RegexParseError):
+        parse_regex(bad)
+
+
+def test_literal_dfa_modes():
+    contains = literal_dfa(b"evilmonkey")
+    assert contains.search(b"xx evilmonkey xx")
+    assert not contains.search(b"evil monkey")
+
+    begins = literal_dfa(b"/admin", begins_with=True)
+    assert begins.search(b"/admin/x")
+    assert not begins.search(b"x/admin")
+
+    ends = literal_dfa(b".php", ends_with=True)
+    assert ends.search(b"index.php")
+    assert not ends.search(b"index.php.txt")
+
+    exact = literal_dfa(b"POST", exact=True)
+    assert exact.search(b"POST")
+    assert not exact.search(b"POSTS")
+    assert not exact.search(b"xPOST")
+
+    ci = literal_dfa(b"Hello", case_insensitive=True)
+    assert ci.search(b"say HELLO!")
+
+
+def test_pm_dfa_is_aho_corasick_like():
+    words = [b"select", b"union", b"drop", b"sleep", b"benchmark"]
+    dfa = pm_dfa(words)
+    assert dfa.search(b"UNION ALL")
+    assert dfa.search(b"xxdropxx")  # @pm matches substrings
+    assert dfa.search(b"BeNcHmArK(")
+    assert not dfa.search(b"innocent request")
+    # State count should stay near the trie size, not blow up.
+    assert dfa.n_states < 10 * sum(len(w) for w in words)
+
+
+def test_posix_classes():
+    # Python re has no [[:alpha:]] syntax, so no oracle here — hand checks.
+    dfa = compile_regex_dfa("[[:alpha:]]+[[:digit:]]")
+    assert dfa.search(b"line1")
+    assert dfa.search(b"abc9def")
+    assert not dfa.search(b"123 456")
+    assert not dfa.search(b"abc def")
+
+    upper = compile_regex_dfa("[[:upper:]]{3}")
+    assert upper.search(b"xxABCxx")
+    assert not upper.search(b"xxAbCxx")
+
+    negated = compile_regex_dfa("[[:^digit:]]x")
+    assert negated.search(b"ax")
+    assert not negated.search(b"9x")
+
+
+def test_byte_class_compression():
+    dfa = compile_regex_dfa("(?i)select")
+    assert dfa.n_classes < 20  # far fewer than 256 byte columns

@@ -1,0 +1,186 @@
+"""Seclang parser unit tests.
+
+Corpus mirrors the reference samples (``config/samples/ruleset.yaml``,
+``test/integration/coreruleset_test.go:60-90``) and the CRS base rules
+(``hack/generate_coreruleset_configmaps.py``).
+"""
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.seclang import (
+    Marker,
+    SeclangParseError,
+    parse,
+)
+
+SQLI_RULE = r"""
+SecRule ARGS "@rx (?i:(\b(select|union|insert|update|delete|drop)\b.*\b(from|into|where|table)\b))" \
+  "id:942100,\
+  phase:2,\
+  deny,\
+  status:403,\
+  t:none,t:urlDecodeUni,\
+  msg:'SQL Injection Attack Detected',\
+  severity:'CRITICAL'"
+"""
+
+EVIL_MONKEY_RULE = r"""
+SecRule ARGS|REQUEST_URI|REQUEST_HEADERS "@contains evilmonkey" \
+  "id:3001,\
+  phase:2,\
+  deny,\
+  status:403,\
+  t:none,t:urlDecodeUni,\
+  msg:'Evil Monkey Detected',\
+  logdata:'Matched Data: %{MATCHED_VAR} found within %{MATCHED_VAR_NAME}',\
+  tag:'application-multi',\
+  tag:'monkey-attack',\
+  severity:'CRITICAL'"
+"""
+
+BASE_RULES = r"""
+SecRuleEngine On
+SecRequestBodyAccess On
+SecRequestBodyLimit 131072
+SecRequestBodyInMemoryLimit 131072
+SecRequestBodyLimitAction Reject
+SecResponseBodyAccess Off
+SecAuditEngine RelevantOnly
+SecAuditLog /dev/stdout
+SecAuditLogFormat JSON
+SecAuditLogRelevantStatus "^(40[0-3]|40[5-9]|4[1-9][0-9]|5[0-9][0-9])$"
+SecDefaultAction "phase:2,log,auditlog,deny,status:403"
+"""
+
+
+def test_parse_sqli_rule():
+    prog = parse(SQLI_RULE)
+    assert len(prog.rules) == 1
+    rule = prog.rules[0]
+    assert rule.id == 942100
+    assert rule.phase == 2
+    assert rule.disruptive == "deny"
+    assert rule.status == 403
+    assert rule.transformations == ["none", "urldecodeuni"]
+    assert rule.severity == "CRITICAL"
+    assert rule.msg == "SQL Injection Attack Detected"
+    assert rule.operator.name == "rx"
+    assert rule.operator.argument.startswith("(?i:")
+    assert [v.name for v in rule.variables] == ["ARGS"]
+
+
+def test_parse_multi_variable_contains():
+    prog = parse(EVIL_MONKEY_RULE)
+    rule = prog.rules[0]
+    assert [v.name for v in rule.variables] == [
+        "ARGS",
+        "REQUEST_URI",
+        "REQUEST_HEADERS",
+    ]
+    assert rule.operator.name == "contains"
+    assert rule.operator.argument == "evilmonkey"
+    assert rule.tags == ["application-multi", "monkey-attack"]
+
+
+def test_parse_base_rules_config():
+    prog = parse(BASE_RULES)
+    assert prog.engine_mode == "On"
+    assert prog.request_body_access is True
+    assert prog.response_body_access is False
+    assert prog.request_body_limit == 131072
+    assert prog.config["secauditengine"] == "RelevantOnly"
+    assert prog.config["secauditlogrelevantstatus"].startswith("^(40")
+    assert 2 in prog.default_actions
+    defaults = {a.name: a.argument for a in prog.default_actions[2]}
+    assert defaults["status"] == "403"
+    assert "deny" in defaults
+
+
+def test_parse_header_selector_and_ctl():
+    text = r"""
+SecRule REQUEST_HEADERS:Content-Type "^application/json" \
+ "id:200001,phase:1,t:none,t:lowercase,pass,nolog,ctl:requestBodyProcessor=JSON"
+"""
+    rule = parse(text).rules[0]
+    var = rule.variables[0]
+    assert var.name == "REQUEST_HEADERS"
+    assert var.selector == "Content-Type"
+    assert rule.operator.name == "rx"  # implicit @rx
+    assert rule.operator.argument == "^application/json"
+    assert rule.first_action("ctl") == "requestBodyProcessor=JSON"
+
+
+def test_parse_negated_operator_and_setvar():
+    text = r"""
+SecRule REQBODY_ERROR "!@eq 0" \
+ "id:200002,phase:2,t:none,log,deny,status:400,msg:'Failed to parse request body.'"
+SecAction "id:900120,phase:1,pass,t:none,nolog,setvar:tx.early_blocking=1"
+"""
+    prog = parse(text)
+    assert prog.rules[0].operator.negated is True
+    assert prog.rules[0].operator.name == "eq"
+    sec_action = prog.rules[1]
+    assert sec_action.operator is None
+    assert sec_action.setvars == ["tx.early_blocking=1"]
+
+
+def test_parse_chain():
+    text = r"""
+SecRule REQUEST_METHOD "@streq POST" "id:100,phase:2,deny,chain"
+SecRule REQUEST_URI "@contains /admin" "t:lowercase"
+"""
+    prog = parse(text)
+    assert len(prog.rules) == 1
+    starter = prog.rules[0]
+    assert starter.is_chain_starter
+    assert len(starter.chain) == 1
+    assert starter.chain[0].operator.name == "contains"
+    assert starter.chain[0].id is None
+
+
+def test_parse_exclusion_and_count_variables():
+    text = 'SecRule ARGS|!ARGS:password|&TX:score "@contains x" "id:7,phase:2,pass"'
+    rule = parse(text).rules[0]
+    assert rule.variables[1].exclude and rule.variables[1].name == "ARGS"
+    assert rule.variables[1].selector == "password"
+    assert rule.variables[2].count and rule.variables[2].name == "TX"
+
+
+def test_parse_marker():
+    prog = parse('SecMarker "END-OF-RULES"')
+    assert isinstance(prog.elements[0], Marker)
+    assert prog.elements[0].name == "END-OF-RULES"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "SecBogusDirective On",
+        'SecRule ARGS "@nosuchop x" "id:1,phase:1,pass"',
+        'SecRule NOTAVAR "@contains x" "id:1,phase:1,pass"',
+        'SecRule ARGS "@contains x" "id:1,phase:9,pass"',
+        'SecRule ARGS "@contains x" "id:1,phase:1,t:nosuchtransform,pass"',
+        'SecRule ARGS "@contains x" "phase:1,pass"',  # missing id
+        'SecRule ARGS "@contains x" "id:1,nosuchaction"',
+        'SecRuleEngine Sideways',
+        'SecRule ARGS "@contains x" "id:1,pass"\n'
+        'SecRule ARGS "@contains y" "id:1,pass"',  # duplicate id
+        'SecRule ARGS "@contains x" "id:1,chain"',  # unterminated chain
+        'SecDefaultAction "log,deny"',  # missing phase
+    ],
+)
+def test_parse_errors(bad):
+    with pytest.raises(SeclangParseError):
+        parse(bad)
+
+
+def test_line_numbers_in_errors():
+    text = "SecRuleEngine On\n\n# comment\nSecRule ARGS \"@nosuchop x\" \"id:1,pass\"\n"
+    with pytest.raises(SeclangParseError) as exc_info:
+        parse(text)
+    assert exc_info.value.line == 4
+
+
+def test_continuation_lines_count_from_start():
+    prog = parse(SQLI_RULE)
+    assert prog.rules[0].line == 2  # rule starts on line 2 (after leading newline)
