@@ -1,0 +1,3 @@
+"""Process entrypoints (the reference's ``cmd/`` analog): ``operator`` runs
+the control plane (manager + cache server), ``tpu_engine`` runs the data
+plane sidecar."""

@@ -1,0 +1,97 @@
+"""``tpu-engine`` sidecar entrypoint — the north-star ``cmd/tpu-engine``.
+
+Flags mirror the args the Engine controller renders into the sidecar
+Deployment (``controlplane/engine_controller.py:build_tpu_engine_deployment``):
+cache instance/cluster/port, reload interval, failure policy, batching knobs.
+``--cache-server-cluster`` accepts a host or host:port — in-mesh this is the
+Envoy cluster name (reference ``--envoy-cluster-name``), standalone it is
+the cache server address.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..sidecar.batcher import DEFAULT_MAX_BATCH_DELAY_MS, DEFAULT_MAX_BATCH_SIZE
+from ..sidecar.reloader import DEFAULT_POLL_INTERVAL_S
+from ..sidecar.server import (
+    FAILURE_POLICY_ALLOW,
+    FAILURE_POLICY_FAIL,
+    SidecarConfig,
+    TpuEngineSidecar,
+)
+from ..utils import get_logger
+
+log = get_logger("cmd.tpu-engine")
+
+
+def build_config(argv: list[str] | None = None) -> SidecarConfig:
+    p = argparse.ArgumentParser(prog="tpu-engine", description=__doc__)
+    p.add_argument(
+        "--cache-server-instance",
+        required=True,
+        help="RuleSet cache key 'namespace/name' to poll",
+    )
+    p.add_argument(
+        "--cache-server-cluster",
+        default="127.0.0.1",
+        help="Cache server host (or host:port); in-mesh, the Envoy cluster name",
+    )
+    p.add_argument("--cache-server-port", type=int, default=18080)
+    p.add_argument(
+        "--rule-reload-interval-seconds",
+        type=float,
+        default=DEFAULT_POLL_INTERVAL_S,
+    )
+    p.add_argument(
+        "--failure-policy",
+        choices=[FAILURE_POLICY_FAIL, FAILURE_POLICY_ALLOW],
+        default=FAILURE_POLICY_FAIL,
+    )
+    p.add_argument("--max-batch-size", type=int, default=DEFAULT_MAX_BATCH_SIZE)
+    p.add_argument(
+        "--max-batch-delay-ms", type=float, default=DEFAULT_MAX_BATCH_DELAY_MS
+    )
+    p.add_argument("--bind-address", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9090)
+    args = p.parse_args(argv)
+
+    cluster = args.cache_server_cluster
+    if ":" in cluster:
+        base_url = f"http://{cluster}"
+    else:
+        base_url = f"http://{cluster}:{args.cache_server_port}"
+    return SidecarConfig(
+        cache_base_url=base_url,
+        instance_key=args.cache_server_instance,
+        poll_interval_s=args.rule_reload_interval_seconds,
+        failure_policy=args.failure_policy,
+        max_batch_size=args.max_batch_size,
+        max_batch_delay_ms=args.max_batch_delay_ms,
+        host=args.bind_address,
+        port=args.port,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    config = build_config(argv)
+    sidecar = TpuEngineSidecar(config)
+    stop = threading.Event()
+
+    def on_signal(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    sidecar.start()
+    log.info("serving", port=sidecar.port)
+    stop.wait()
+    sidecar.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""tpu-engine sidecar: the first-party TPU data plane.
+
+The reference outsources per-request evaluation to coraza-proxy-wasm inside
+Envoy (SURVEY §2.2, §3.4); this package is that component rebuilt TPU-first:
+an HTTP sidecar that micro-batches in-flight requests, evaluates each batch
+in one device step (``models/waf_model.eval_waf``), enforces the Engine's
+``failurePolicy``, and hot-reloads rules through the same cache-poll
+contract the WASM plugin uses (uuid change ⇒ recompile ⇒ swap tables).
+"""
+
+from .batcher import MicroBatcher
+from .reloader import RuleReloader
+from .server import SidecarConfig, TpuEngineSidecar
+
+__all__ = ["MicroBatcher", "RuleReloader", "SidecarConfig", "TpuEngineSidecar"]

@@ -1,0 +1,133 @@
+"""Rule hot reload: poll the cache server, recompile off the serving path.
+
+Implements the data-plane half of the cache-poll contract the reference
+configures into coraza-proxy-wasm (pluginConfig keys
+``cache_server_instance`` / ``cache_server_cluster`` /
+``rule_reload_interval_seconds``, reference
+``engine_controller_driver_istio.go:96-103``; poll loop behavior SURVEY
+§3.4):
+
+- every ``poll_interval_s``: ``GET /rules/{key}/latest`` → ``{uuid, ts}``;
+- uuid unchanged → nothing;
+- uuid changed → ``GET /rules/{key}`` → full rules → compile (slow, Python,
+  happens on this thread — never on the serving path) → build device model
+  → atomic engine swap; the next batch window picks it up.
+
+Compile failures keep the previous engine serving (the WASM plugin behaves
+the same way: last-loaded rules keep running).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+from ..engine.waf import WafEngine
+from ..utils import get_logger
+
+log = get_logger("sidecar.reloader")
+
+DEFAULT_POLL_INTERVAL_S = 15.0
+
+
+class RuleReloader:
+    """Background poller owning the current (engine, uuid) pair."""
+
+    def __init__(
+        self,
+        cache_base_url: str,
+        instance_key: str,
+        poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+        engine_factory=WafEngine,
+    ):
+        self.cache_base_url = cache_base_url.rstrip("/")
+        self.instance_key = instance_key.strip("/")
+        self.poll_interval_s = poll_interval_s
+        self._engine_factory = engine_factory
+        self._engine: WafEngine | None = None
+        self._uuid: str | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._loaded_once = threading.Event()
+        self.reloads = 0
+        self.failed_reloads = 0
+
+    # -- public --------------------------------------------------------------
+
+    @property
+    def engine(self) -> WafEngine | None:
+        return self._engine
+
+    @property
+    def current_uuid(self) -> str | None:
+        return self._uuid
+
+    def seed(self, engine: WafEngine, uuid: str | None = None) -> None:
+        """Install a pre-built engine (static rules / tests) through the same
+        swap invariant the poll path uses."""
+        self._engine = engine
+        self._uuid = uuid
+        self._loaded_once.set()
+
+    def start(self) -> None:
+        # First load happens on the poll thread, never the caller: a large
+        # ruleset compile must not delay the HTTP listener (fail-open and
+        # probe semantics depend on the server being up).
+        self._thread = threading.Thread(target=self._run, name="reloader", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_loaded(self, timeout_s: float) -> bool:
+        return self._loaded_once.wait(timeout=timeout_s)
+
+    def poll_once(self) -> bool:
+        """One poll step; returns True if a new ruleset was swapped in."""
+        try:
+            latest = self._get_json(f"/rules/{self.instance_key}/latest")
+        except (urllib.error.URLError, ValueError, OSError) as err:
+            log.debug("cache poll failed", key=self.instance_key, error=str(err))
+            return False
+        uuid = latest.get("uuid")
+        if not uuid or uuid == self._uuid:
+            return False
+        try:
+            entry = self._get_json(f"/rules/{self.instance_key}")
+        except (urllib.error.URLError, ValueError, OSError) as err:
+            log.info("rules fetch failed", key=self.instance_key, error=str(err))
+            return False
+        rules = entry.get("rules", "")
+        try:
+            engine = self._engine_factory(rules)
+        except Exception as err:  # invalid rules: keep serving previous engine
+            self.failed_reloads += 1
+            log.error("rule compile failed; keeping previous ruleset", err, uuid=uuid)
+            return False
+        self._engine = engine  # atomic swap; next batch window uses it
+        self._uuid = uuid
+        self.reloads += 1
+        self._loaded_once.set()
+        log.info(
+            "ruleset reloaded",
+            key=self.instance_key,
+            uuid=uuid,
+            rules=engine.compiled.n_rules,
+            groups=engine.compiled.n_groups,
+        )
+        return True
+
+    # -- internals -----------------------------------------------------------
+
+    def _get_json(self, path: str) -> dict:
+        with urllib.request.urlopen(self.cache_base_url + path, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    def _run(self) -> None:
+        self.poll_once()  # eager first load, off the caller's thread
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_once()

@@ -1,0 +1,328 @@
+"""tpu-engine sidecar HTTP server.
+
+Two serving surfaces, mirroring how the reference data plane is consumed
+(SURVEY §3.4 — Envoy filter semantics; integration assertions ExpectBlocked
+403 / ExpectAllowed 200, reference ``test/framework/traffic.go:109-120``):
+
+- **Filter mode** (any path outside ``/waf/v1/``): the *inbound request
+  itself* is evaluated. Blocked → the rule's status (403); allowed → 200
+  with ``x-waf-action: allow``. This is the drop-in stand-in for the Envoy
+  filter in front of an upstream.
+- **Bulk mode** (``POST /waf/v1/evaluate``): a JSON object
+  ``{"requests": [...]}`` of serialized requests evaluated in one call —
+  the high-throughput path for replayers and load generators, and the
+  shape the benchmarks use.
+
+Control endpoints: ``/waf/v1/healthz`` (ready once a ruleset is loaded) and
+``/waf/v1/stats`` (batcher + reloader counters).
+
+``failurePolicy`` (reference ``api/v1alpha1/engine_types.go:153-166``, which
+the reference stores but never forwards — SURVEY §5): with no loaded
+ruleset, ``fail`` (fail-closed) answers 503, ``allow`` (fail-open) passes
+requests through unevaluated.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..engine.request import HttpRequest
+from ..engine.waf import Verdict, WafEngine
+from ..utils import get_logger
+from .batcher import (
+    DEFAULT_MAX_BATCH_DELAY_MS,
+    DEFAULT_MAX_BATCH_SIZE,
+    EngineUnavailable,
+    MicroBatcher,
+)
+from .reloader import DEFAULT_POLL_INTERVAL_S, RuleReloader
+
+log = get_logger("sidecar.server")
+
+API_PREFIX = "/waf/v1/"
+FAILURE_POLICY_FAIL = "fail"
+FAILURE_POLICY_ALLOW = "allow"
+
+
+@dataclass
+class SidecarConfig:
+    """Mirrors the args the Engine controller passes to the Deployment
+    (``controlplane/engine_controller.py:build_tpu_engine_deployment``)."""
+
+    cache_base_url: str = "http://127.0.0.1:18080"
+    instance_key: str = "default/ruleset"
+    poll_interval_s: float = DEFAULT_POLL_INTERVAL_S
+    failure_policy: str = FAILURE_POLICY_FAIL
+    max_batch_size: int = DEFAULT_MAX_BATCH_SIZE
+    max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS
+    host: str = "0.0.0.0"
+    port: int = 9090
+    request_timeout_s: float = 30.0
+
+
+def request_from_json(obj: dict) -> HttpRequest:
+    headers = obj.get("headers", [])
+    if isinstance(headers, dict):
+        headers = list(headers.items())
+    body = obj.get("body", "")
+    if isinstance(body, str):
+        body = body.encode("utf-8", "replace")
+    return HttpRequest(
+        method=obj.get("method", "GET"),
+        uri=obj.get("uri", "/"),
+        version=obj.get("version", "HTTP/1.1"),
+        headers=[(str(k), str(v)) for k, v in headers],
+        body=body,
+        remote_addr=obj.get("remote_addr", ""),
+    )
+
+
+def verdict_to_json(v: Verdict) -> dict:
+    return {
+        "interrupted": v.interrupted,
+        "status": v.status,
+        "rule_id": v.rule_id,
+        "matched_ids": v.matched_ids,
+        "scores": v.scores,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "cko-tpu-engine"
+
+    @property
+    def sidecar(self) -> "TpuEngineSidecar":
+        return self.server.sidecar  # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args) -> None:
+        log.debug("http " + fmt % args)
+
+    def _reply(self, status: int, payload: bytes, headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _reply_json(self, status: int, obj, headers: dict | None = None) -> None:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        self._reply(status, json.dumps(obj).encode(), h)
+
+    def _read_body(self) -> bytes:
+        # A WAF must see the body however it is framed: chunked bodies are
+        # decoded (not evaluating them would be a rule bypass, and leaving
+        # them unread desyncs HTTP/1.1 keep-alive framing).
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            return self._read_chunked()
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length > 0 else b""
+
+    def _read_chunked(self) -> bytes:
+        chunks: list[bytes] = []
+        while True:
+            size_line = self.rfile.readline(65536).strip()
+            try:
+                size = int(size_line.split(b";", 1)[0], 16)
+            except ValueError:
+                break
+            if size == 0:
+                # Trailers until blank line.
+                while self.rfile.readline(65536).strip():
+                    pass
+                break
+            chunks.append(self.rfile.read(size))
+            self.rfile.readline(65536)  # CRLF after chunk data
+        return b"".join(chunks)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path == API_PREFIX + "healthz":
+            self._handle_healthz()
+        elif path == API_PREFIX + "stats":
+            self._reply_json(200, self.sidecar.stats())
+        elif path.startswith(API_PREFIX):
+            self._reply_json(404, {"error": "not found"})
+        else:
+            self._handle_filter(b"")
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        body = self._read_body()
+        if path == API_PREFIX + "evaluate":
+            self._handle_bulk(body)
+        elif path.startswith(API_PREFIX):
+            self._reply_json(404, {"error": "not found"})
+        else:
+            self._handle_filter(body)
+
+    do_PUT = do_PATCH = do_DELETE = do_POST  # noqa: N815
+
+    # -- handlers ------------------------------------------------------------
+
+    def _handle_healthz(self) -> None:
+        if self.sidecar.ready():
+            self._reply(200, b"ok\n", {"Content-Type": "text/plain"})
+        else:
+            self._reply(503, b"no ruleset loaded\n", {"Content-Type": "text/plain"})
+
+    def _handle_filter(self, body: bytes) -> None:
+        req = HttpRequest(
+            method=self.command,
+            uri=self.path,
+            version=self.request_version,
+            headers=[(k, v) for k, v in self.headers.items()],
+            body=body,
+            remote_addr=self.client_address[0],
+        )
+        try:
+            verdict = self.sidecar.evaluate(req)
+        except EngineUnavailable:
+            self._unavailable()
+            return
+        except Exception as err:  # evaluation failure → failurePolicy
+            log.error("filter evaluation failed", err)
+            self._unavailable()
+            return
+        if verdict.interrupted:
+            self._reply(
+                verdict.status,
+                b"blocked by WAF\n",
+                {
+                    "Content-Type": "text/plain",
+                    "x-waf-action": "deny",
+                    "x-waf-rule-id": str(verdict.rule_id or 0),
+                },
+            )
+        else:
+            self._reply(
+                200,
+                b"allowed\n",
+                {"Content-Type": "text/plain", "x-waf-action": "allow"},
+            )
+
+    def _handle_bulk(self, body: bytes) -> None:
+        try:
+            payload = json.loads(body.decode("utf-8"))
+            reqs = [request_from_json(o) for o in payload["requests"]]
+        except (ValueError, KeyError, TypeError) as err:
+            self._reply_json(400, {"error": f"invalid request payload: {err}"})
+            return
+        try:
+            verdicts = self.sidecar.evaluate_many(reqs)
+        except EngineUnavailable:
+            self._unavailable()
+            return
+        except Exception as err:  # evaluation failure: explicit 500, not a
+            log.error("bulk evaluation failed", err)  # dropped connection
+            self._reply_json(500, {"error": f"evaluation failed: {err}"})
+            return
+        self._reply_json(200, {"verdicts": [verdict_to_json(v) for v in verdicts]})
+
+    def _unavailable(self) -> None:
+        # Fail-open: pass the request through unevaluated. Fail-closed: 503.
+        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self._reply(
+                200,
+                b"allowed (fail-open: no ruleset loaded)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
+            )
+        else:
+            self._reply(
+                503,
+                b"WAF unavailable (fail-closed)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
+            )
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class TpuEngineSidecar:
+    """Wires reloader + batcher + HTTP server; the deployable unit."""
+
+    def __init__(self, config: SidecarConfig, engine: WafEngine | None = None):
+        self.config = config
+        self.reloader = RuleReloader(
+            cache_base_url=config.cache_base_url,
+            instance_key=config.instance_key,
+            poll_interval_s=config.poll_interval_s,
+        )
+        if engine is not None:  # pre-seeded (tests / static rules)
+            self.reloader.seed(engine)
+        self.batcher = MicroBatcher(
+            engine_fn=lambda: self.reloader.engine,
+            max_batch_size=config.max_batch_size,
+            max_batch_delay_ms=config.max_batch_delay_ms,
+        )
+        self._httpd = _Server((config.host, config.port), _Handler)
+        self._httpd.sidecar = self  # type: ignore[attr-defined]
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def ready(self) -> bool:
+        return self.reloader.engine is not None
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, request: HttpRequest) -> Verdict:
+        if self.reloader.engine is None:
+            raise EngineUnavailable("no compiled ruleset loaded")
+        return self.batcher.evaluate(request, timeout_s=self.config.request_timeout_s)
+
+    def evaluate_many(self, requests: list[HttpRequest]) -> list[Verdict]:
+        if self.reloader.engine is None:
+            raise EngineUnavailable("no compiled ruleset loaded")
+        futures: list[Future] = [self.batcher.submit(r) for r in requests]
+        return [f.result(timeout=self.config.request_timeout_s) for f in futures]
+
+    def stats(self) -> dict:
+        return {
+            "batcher": self.batcher.stats.snapshot(),
+            "ruleset_uuid": self.reloader.current_uuid,
+            "reloads": self.reloader.reloads,
+            "failed_reloads": self.reloader.failed_reloads,
+            "ready": self.ready(),
+            "failure_policy": self.config.failure_policy,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.batcher.start()
+        self.reloader.start()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="sidecar-http", daemon=True
+        )
+        self._serve_thread.start()
+        log.info(
+            "tpu-engine sidecar started",
+            addr=f":{self.port}",
+            instance=self.config.instance_key,
+            failurePolicy=self.config.failure_policy,
+            maxBatch=self.config.max_batch_size,
+        )
+
+    def stop(self) -> None:
+        # Stop accepting connections first, then drain the batcher (which
+        # fails any still-queued futures fast), then the reloader.
+        self._httpd.shutdown()
+        if self._serve_thread:
+            self._serve_thread.join(timeout=10)
+        self._httpd.server_close()
+        self.batcher.stop()
+        self.reloader.stop()
+        log.info("tpu-engine sidecar stopped")

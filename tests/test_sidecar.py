@@ -1,0 +1,343 @@
+"""tpu-engine sidecar tests: HTTP filter + bulk modes, micro-batching,
+cache-poll hot reload, failurePolicy fail/allow.
+
+Mirrors the reference integration scenarios on an in-process stack: cache
+server + sidecar replace kind + Istio + Envoy + WASM (reference
+``test/integration/reconcile_test.go`` live-mutation propagation;
+``traffic.go:109-120`` blocked=403 / allowed=200 assertion semantics).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+from coraza_kubernetes_operator_tpu.sidecar.batcher import MicroBatcher
+from coraza_kubernetes_operator_tpu.cmd.tpu_engine import build_config
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+
+EVIL_MONKEY = r"""
+SecRule ARGS|REQUEST_URI "@contains evilmonkey" \
+  "id:3001,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'Evil Monkey'"
+"""
+
+TIGER_RULE = r"""
+SecRule ARGS|REQUEST_URI "@contains eviltiger" \
+  "id:3002,phase:2,deny,status:403,t:none,msg:'Evil Tiger'"
+"""
+
+KEY = "default/waf-rules"
+
+
+@pytest.fixture()
+def cache_server():
+    cache = RuleSetCache()
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _sidecar(cache_server, poll_s=0.05, failure_policy="fail", **kw):
+    config = SidecarConfig(
+        cache_base_url=f"http://127.0.0.1:{cache_server.port}",
+        instance_key=KEY,
+        poll_interval_s=poll_s,
+        failure_policy=failure_policy,
+        max_batch_size=kw.pop("max_batch_size", 64),
+        max_batch_delay_ms=kw.pop("max_batch_delay_ms", 1.0),
+        host="127.0.0.1",
+        port=0,
+        **kw,
+    )
+    return TpuEngineSidecar(config)
+
+
+def _http(sidecar, path, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{sidecar.port}{path}",
+        method=method,
+        data=body,
+        headers=headers or {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _wait(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# -- filter mode ------------------------------------------------------------
+
+
+def test_filter_mode_blocks_and_allows(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        status, headers, _ = _http(sc, "/?pet=evilmonkey")
+        assert status == 403
+        assert headers["x-waf-action"] == "deny"
+        assert headers["x-waf-rule-id"] == "3001"
+
+        status, headers, _ = _http(sc, "/index.html?q=hello")
+        assert status == 200
+        assert headers["x-waf-action"] == "allow"
+    finally:
+        sc.stop()
+
+
+def test_filter_mode_post_body_blocked(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        status, _, _ = _http(
+            sc,
+            "/submit",
+            method="POST",
+            body=b"pet=evilmonkey",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        assert status == 403
+    finally:
+        sc.stop()
+
+
+def test_filter_mode_chunked_body_blocked(cache_server):
+    """Chunked framing must not bypass body rules (no Content-Length)."""
+    import http.client
+
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        conn = http.client.HTTPConnection("127.0.0.1", sc.port, timeout=10)
+        conn.putrequest("POST", "/submit")
+        conn.putheader("Content-Type", "application/x-www-form-urlencoded")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        payload = b"pet=evilmonkey"
+        conn.send(b"%x\r\n%s\r\n0\r\n\r\n" % (len(payload), payload))
+        resp = conn.getresponse()
+        assert resp.status == 403
+        conn.close()
+    finally:
+        sc.stop()
+
+
+# -- bulk mode --------------------------------------------------------------
+
+
+def test_bulk_evaluate(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        payload = json.dumps(
+            {
+                "requests": [
+                    {"method": "GET", "uri": "/?a=evilmonkey"},
+                    {"method": "GET", "uri": "/clean"},
+                    {
+                        "method": "POST",
+                        "uri": "/f",
+                        "headers": {"Content-Type": "application/x-www-form-urlencoded"},
+                        "body": "x=evilmonkey",
+                    },
+                ]
+            }
+        ).encode()
+        status, _, body = _http(
+            sc, "/waf/v1/evaluate", method="POST", body=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200
+        verdicts = json.loads(body)["verdicts"]
+        assert [v["interrupted"] for v in verdicts] == [True, False, True]
+        assert verdicts[0]["status"] == 403
+        assert verdicts[0]["rule_id"] == 3001
+    finally:
+        sc.stop()
+
+
+def test_bulk_evaluate_invalid_payload(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        status, _, _ = _http(sc, "/waf/v1/evaluate", method="POST", body=b"not json")
+        assert status == 400
+    finally:
+        sc.stop()
+
+
+# -- hot reload -------------------------------------------------------------
+
+
+def test_hot_reload_on_uuid_change(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server, poll_s=0.05)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        # tiger not blocked under v1
+        status, _, _ = _http(sc, "/?pet=eviltiger")
+        assert status == 200
+
+        cache_server.cache.put(KEY, BASE + EVIL_MONKEY + TIGER_RULE)
+        assert _wait(lambda: sc.reloader.reloads >= 2, timeout_s=15)
+        status, _, _ = _http(sc, "/?pet=eviltiger")
+        assert status == 403
+        # original rule still active
+        status, _, _ = _http(sc, "/?pet=evilmonkey")
+        assert status == 403
+    finally:
+        sc.stop()
+
+
+def test_reload_keeps_previous_engine_on_invalid_rules(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server, poll_s=0.05)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        good_uuid = sc.reloader.current_uuid
+        cache_server.cache.put(KEY, 'SecRule ARGS "@rx (unclosed" "id:9,phase:2,deny"')
+        assert _wait(lambda: sc.reloader.failed_reloads >= 1, timeout_s=15)
+        # Previous engine still serving, uuid unchanged.
+        assert sc.reloader.current_uuid == good_uuid
+        status, _, _ = _http(sc, "/?pet=evilmonkey")
+        assert status == 403
+    finally:
+        sc.stop()
+
+
+# -- failure policy ---------------------------------------------------------
+
+
+def test_failure_policy_fail_closed(cache_server):
+    # Cache is empty: nothing to load.
+    sc = _sidecar(cache_server, failure_policy="fail")
+    sc.start()
+    try:
+        status, headers, _ = _http(sc, "/anything")
+        assert status == 503
+        assert headers["x-waf-action"] == "fail-closed"
+        status, _, _ = _http(sc, "/waf/v1/healthz")
+        assert status == 503
+    finally:
+        sc.stop()
+
+
+def test_failure_policy_fail_open(cache_server):
+    sc = _sidecar(cache_server, failure_policy="allow")
+    sc.start()
+    try:
+        status, headers, _ = _http(sc, "/anything")
+        assert status == 200
+        assert headers["x-waf-action"] == "fail-open"
+    finally:
+        sc.stop()
+
+
+def test_recovers_when_rules_appear(cache_server):
+    sc = _sidecar(cache_server, failure_policy="fail", poll_s=0.05)
+    sc.start()
+    try:
+        status, _, _ = _http(sc, "/x")
+        assert status == 503
+        cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+        assert _wait(sc.ready, timeout_s=15)
+        status, _, _ = _http(sc, "/?pet=evilmonkey")
+        assert status == 403
+        status, _, _ = _http(sc, "/clean")
+        assert status == 200
+    finally:
+        sc.stop()
+
+
+# -- stats + batching -------------------------------------------------------
+
+
+def test_stats_and_batching(cache_server):
+    cache_server.cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = _sidecar(cache_server, max_batch_delay_ms=20.0)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        payload = json.dumps(
+            {"requests": [{"uri": f"/p{i}"} for i in range(32)]}
+        ).encode()
+        status, _, body = _http(sc, "/waf/v1/evaluate", method="POST", body=payload)
+        assert status == 200
+        status, _, body = _http(sc, "/waf/v1/stats")
+        stats = json.loads(body)
+        assert stats["ready"] is True
+        assert stats["ruleset_uuid"]
+        assert stats["batcher"]["requests"] >= 32
+        # Micro-batching actually coalesced concurrent submits.
+        assert stats["batcher"]["mean_batch_size"] > 1
+    finally:
+        sc.stop()
+
+
+def test_batcher_direct_coalescing():
+    engine = WafEngine(BASE + EVIL_MONKEY)
+    b = MicroBatcher(lambda: engine, max_batch_size=16, max_batch_delay_ms=50.0)
+    b.start()
+    try:
+        futs = [b.submit(HttpRequest(uri=f"/?q=evilmonkey{i}")) for i in range(16)]
+        verdicts = [f.result(timeout=30) for f in futs]
+        assert all(v.interrupted for v in verdicts)
+        assert b.stats.batches < 16  # coalesced
+    finally:
+        b.stop()
+
+
+# -- CLI config -------------------------------------------------------------
+
+
+def test_build_config_defaults():
+    cfg = build_config(["--cache-server-instance", "ns/rs"])
+    assert cfg.instance_key == "ns/rs"
+    assert cfg.cache_base_url == "http://127.0.0.1:18080"
+    assert cfg.failure_policy == "fail"
+
+
+def test_build_config_host_port():
+    cfg = build_config(
+        [
+            "--cache-server-instance", "ns/rs",
+            "--cache-server-cluster", "cache.svc:8080",
+            "--failure-policy", "allow",
+            "--max-batch-size", "128",
+        ]
+    )
+    assert cfg.cache_base_url == "http://cache.svc:8080"
+    assert cfg.failure_policy == "allow"
+    assert cfg.max_batch_size == 128
