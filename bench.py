@@ -12,6 +12,7 @@ Config via env:
 """
 
 import json
+import math
 import os
 import statistics
 import sys
@@ -51,7 +52,7 @@ def main() -> None:
         jax.block_until_ready(out["interrupted"])
         lat.append(time.perf_counter() - t0)
     device_rps = batch / statistics.median(lat)
-    p99_ms = sorted(lat)[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+    p99_ms = sorted(lat)[max(0, math.ceil(len(lat) * 0.99) - 1)] * 1e3
 
     # --- end-to-end throughput (extraction + tensorize + eval) ------------
     t0 = time.perf_counter()

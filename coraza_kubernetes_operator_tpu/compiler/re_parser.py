@@ -155,12 +155,6 @@ class _Parser:
 
     # -- grammar ------------------------------------------------------------
 
-    def parse(self) -> object:
-        node = self.alternation(_Flags())
-        if self.i != self.n:
-            raise self.error(f"unexpected {self.p[self.i]!r}")
-        return node
-
     def alternation(self, flags: _Flags) -> object:
         branches = [self.concat(flags)]
         while self.eat("|"):
@@ -316,10 +310,14 @@ class _Parser:
         c = self.next()
         simple = {
             "n": b"\n", "r": b"\r", "t": b"\t", "f": b"\f", "v": b"\v",
-            "a": b"\a", "e": b"\x1b", "0": b"\0",
+            "a": b"\a", "e": b"\x1b",
         }
         if c in simple:
             return RChar(_mask_of(simple[c]))
+        if c in "01234567":
+            # RE2 octal escape: up to three octal digits (\0, \12, \123).
+            mask = 1 << self._octal(c)
+            return RChar(case_fold(mask) if flags.i else mask)
         if c == "d":
             return RChar(DIGIT)
         if c == "D":
@@ -341,19 +339,12 @@ class _Parser:
         if c in ("z", "Z"):
             return RAssert("end")
         if c == "x":
-            if self.eat("{"):
-                start = self.i
-                while self.next() != "}":
-                    pass
-                val = int(self.p[start : self.i - 1], 16)
-                if val > 0xFF:
-                    raise self.error("non-byte codepoint (matching is byte-level)")
-            else:
-                h = self.next() + self.next()
-                val = int(h, 16)
+            val = self._hex_escape()
+            if val > 0xFF:
+                raise self.error("non-byte codepoint (matching is byte-level)")
             mask = 1 << val
             return RChar(case_fold(mask) if flags.i else mask)
-        if c.isdigit():
+        if c.isdigit():  # \8, \9 — not octal, and RE2 has no backreferences
             raise self.error("backreferences not supported (RE2 subset)")
         if c == "Q":
             # \Q...\E literal quoting
@@ -370,6 +361,29 @@ class _Parser:
             m = 1 << ord(c)
             return RChar(case_fold(m) if flags.i else m)
         raise self.error(f"unsupported escape \\{c}")
+
+    def _octal(self, first: str) -> int:
+        digits = first
+        while len(digits) < 3 and (self.peek() or "") in "01234567":
+            digits += self.next()
+        val = int(digits, 8)
+        if val > 0xFF:
+            raise self.error(f"octal escape \\{digits} out of byte range")
+        return val
+
+    def _hex_escape(self) -> int:
+        """Value of a ``\\x``-escape body: two hex digits or ``{...}``."""
+        if self.eat("{"):
+            start = self.i
+            while self.next() != "}":
+                pass
+            body = self.p[start : self.i - 1]
+        else:
+            body = self.next() + self.next()
+        try:
+            return int(body, 16)
+        except ValueError:
+            raise self.error(f"invalid hex escape \\x{body!r}") from None
 
     def char_class(self, flags: _Flags) -> int:
         negate = self.eat("^")
@@ -429,11 +443,13 @@ class _Parser:
             e = self.next()
             table = {
                 "n": _mask_of(b"\n"), "r": _mask_of(b"\r"), "t": _mask_of(b"\t"),
-                "f": _mask_of(b"\f"), "v": _mask_of(b"\v"), "0": _mask_of(b"\0"),
+                "f": _mask_of(b"\f"), "v": _mask_of(b"\v"),
                 "a": _mask_of(b"\a"), "e": _mask_of(b"\x1b"), "b": _mask_of(b"\x08"),
             }
             if e in table:
                 return table[e]
+            if e in "01234567":
+                return 1 << self._octal(e)
             if e == "d":
                 return DIGIT
             if e == "D":
@@ -447,13 +463,7 @@ class _Parser:
             if e == "S":
                 return ALL_BYTES & ~SPACE
             if e == "x":
-                if self.eat("{"):
-                    start = self.i
-                    while self.next() != "}":
-                        pass
-                    val = int(self.p[start : self.i - 1], 16)
-                else:
-                    val = int(self.next() + self.next(), 16)
+                val = self._hex_escape()
                 if val > 0xFF:
                     raise self.error("non-byte codepoint in class")
                 return 1 << val

@@ -132,7 +132,15 @@ def t_htmlentitydecode(data: bytes) -> bytes:
                     continue
         else:
             start = j
-            while j < n and (chr(data[j]).isalnum()) and j - start < 8:
+            while (
+                j < n
+                and (
+                    0x30 <= data[j] <= 0x39
+                    or 0x41 <= data[j] <= 0x5A
+                    or 0x61 <= data[j] <= 0x7A
+                )
+                and j - start < 8
+            ):
                 j += 1
             name = bytes(data[start:j]).lower()
             if j < n and data[j] == 0x3B and name in _NAMED_ENTITIES:
@@ -410,7 +418,9 @@ def t_hexencode(data: bytes) -> bytes:
 def t_urlencode(data: bytes) -> bytes:
     out = bytearray()
     for b in data:
-        if chr(b).isalnum() or b in b"-_.":
+        # ASCII alnum only: chr().isalnum() is also True for Latin-1 letters
+        # (0xB5, 0xC0-0xFF...), which ModSecurity's urlEncode does encode.
+        if 0x30 <= b <= 0x39 or 0x41 <= b <= 0x5A or 0x61 <= b <= 0x7A or b in b"-_.":
             out.append(b)
         else:
             out += b"%%%02x" % b

@@ -142,19 +142,21 @@ def _tokenize(line: str, lineno: int) -> list[str]:
 def _parse_variables(token: str, lineno: int) -> list[Variable]:
     variables: list[Variable] = []
     # Split on '|' at top level. '|' inside a /regex/ selector is literal;
-    # regex mode starts only when '/' immediately follows the ':' selector
-    # separator and ends at the next '/' (a '/' elsewhere in a plain
-    # selector, e.g. ARGS:a/b, is just a character).
+    # regex mode starts when '/' follows the ':' selector separator (plain
+    # form ARGS:/re/) or ":'" (quoted form ARGS:'/re/') and ends at the next
+    # '/' (a '/' elsewhere in a plain selector, e.g. ARGS:a/b, is just a
+    # character).
     parts: list[str] = []
     buf: list[str] = []
     in_regex = False
     prev: str | None = None
+    prev2: str | None = None
     for c in token:
         if in_regex:
             buf.append(c)
             if c == "/":
                 in_regex = False
-        elif c == "/" and prev == ":":
+        elif c == "/" and (prev == ":" or (prev == "'" and prev2 == ":")):
             in_regex = True
             buf.append(c)
         elif c == "|":
@@ -162,6 +164,7 @@ def _parse_variables(token: str, lineno: int) -> list[Variable]:
             buf = []
         else:
             buf.append(c)
+        prev2 = prev
         prev = c
     if in_regex:
         raise SeclangParseError("unterminated /regex/ selector", lineno)

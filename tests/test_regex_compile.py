@@ -236,3 +236,21 @@ def test_posix_classes():
 def test_byte_class_compression():
     dfa = compile_regex_dfa("(?i)select")
     assert dfa.n_classes < 20  # far fewer than 256 byte columns
+
+
+def test_octal_escapes():
+    # RE2 octal: \012 is newline, \0 is NUL, up to three digits.
+    assert compile_regex_dfa(r"a\012b").search(b"a\nb")
+    assert not compile_regex_dfa(r"a\012b").search(b"a\x0012b")
+    assert compile_regex_dfa(r"\0x").search(b"\x00x")
+    assert compile_regex_dfa(r"[\101-\103]+").search(b"ABC")
+    assert not compile_regex_dfa(r"[\101-\103]+").search(b"abc")
+    with pytest.raises(RegexParseError):
+        compile_regex_dfa(r"\777")  # > 0xFF
+
+
+def test_invalid_hex_escape_raises_parse_error():
+    with pytest.raises(RegexParseError):
+        compile_regex_dfa(r"\x{zz}")
+    with pytest.raises(RegexParseError):
+        compile_regex_dfa(r"[\x{zz}]")

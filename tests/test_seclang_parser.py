@@ -184,3 +184,15 @@ def test_line_numbers_in_errors():
 def test_continuation_lines_count_from_start():
     prog = parse(SQLI_RULE)
     assert prog.rules[0].line == 2  # rule starts on line 2 (after leading newline)
+
+
+def test_quoted_regex_selector_with_alternation():
+    # '|' inside a quoted /regex/ selector is literal, not a variable split.
+    program = parse(
+        "SecRule REQUEST_HEADERS:'/^(a|b)$/' \"@rx x\" \"id:7001,phase:1,pass\""
+    )
+    (rule,) = program.rules
+    (var,) = rule.variables
+    assert var.name == "REQUEST_HEADERS"
+    assert var.selector_is_regex
+    assert var.selector == "^(a|b)$"

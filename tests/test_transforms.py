@@ -134,3 +134,9 @@ def test_base64_host():
     assert host.t_base64decode(b"aGVsbG8=") == b"hello"
     assert host.t_base64decodeext(b"aGV!sbG8") == b"hello"
     assert host.t_hexdecode(b"68656c6c6f") == b"hello"
+
+
+def test_urlencode_encodes_non_ascii():
+    from coraza_kubernetes_operator_tpu.compiler.transforms_host import t_urlencode
+
+    assert t_urlencode(bytes([0xB5, 0xC0, 0xAA, 0x20]) + b"a") == b"%b5%c0%aa%20a"
