@@ -5,6 +5,13 @@ Baseline = the BASELINE.json north star (1M req/s full-CRS on one v5e-1),
 so vs_baseline is value / 1e6. Extra keys carry the e2e (incl. Python
 extraction) number and batch latency percentiles.
 
+Methodology: throughput is wall time over N back-to-back evaluations of
+device-distinct batches (async dispatch pipelined, one final block) — the
+steady-state serving shape; per-call latency is measured separately with a
+block per call. Isolated single-call timings through the axon tunnel were
+observed to be unreliable in both directions; the wall-loop agrees with
+end-to-end serving numbers.
+
 Config via env:
   BENCH_RULES   — number of synthetic CRS-style rules (default 200)
   BENCH_BATCH   — requests per batch (default 1024)
@@ -41,17 +48,39 @@ def main() -> None:
     t_extract0 = time.perf_counter()
     tensors = engine._tensorize(extractions)
     tensorize_s = time.perf_counter() - t_extract0
+    # Device-resident copies: the throughput loop must measure device work,
+    # not per-call host-to-device shipping of numpy arguments.
+    data = jax.numpy.asarray(tensors[0])
+    rest = jax.device_put(tuple(tensors[1:]))
 
     out = eval_waf(engine.model, *tensors)  # compile + warm
     jax.block_until_ready(out["interrupted"])
+    warm = [
+        eval_waf(engine.model, data.at[0, 0].set(i), *rest)["interrupted"]
+        for i in range(8)
+    ]  # warm the .set executable + allocator/tunnel (first loop round
+    jax.block_until_ready(warm)  # otherwise measures ~4x slow)
 
+    # Throughput: back-to-back distinct batches (device-side perturbation,
+    # no host uploads), one final block.
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(iters):
+        d = data.at[0, 0].set(i % 250)
+        outs.append(eval_waf(engine.model, d, *rest)["interrupted"])
+    jax.block_until_ready(outs)
+    wall = (time.perf_counter() - t0) / iters
+    device_rps = batch / wall
+
+    # Latency: block per call.
     lat = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = eval_waf(engine.model, *tensors)
-        jax.block_until_ready(out["interrupted"])
-        lat.append(time.perf_counter() - t0)
-    device_rps = batch / statistics.median(lat)
+    for i in range(iters):
+        d = data.at[0, 1].set(i % 250)
+        t1 = time.perf_counter()
+        o = eval_waf(engine.model, d, *rest)
+        jax.block_until_ready(o["interrupted"])
+        lat.append(time.perf_counter() - t1)
+    p50_ms = statistics.median(lat) * 1e3
     p99_ms = sorted(lat)[max(0, math.ceil(len(lat) * 0.99) - 1)] * 1e3
 
     # --- end-to-end throughput (extraction + tensorize + eval) ------------
@@ -68,6 +97,7 @@ def main() -> None:
         "unit": "req/s",
         "vs_baseline": round(device_rps / 1_000_000, 4),
         "e2e_req_per_s": round(e2e_rps, 1),
+        "p50_batch_ms": round(p50_ms, 2),
         "p99_batch_ms": round(p99_ms, 2),
         "batch": batch,
         "rules_requested": n_rules,

@@ -1,0 +1,94 @@
+"""Stage-by-stage timing of the real bench workload (synthetic CRS).
+
+Separates: host extract, host tensorize, device transforms, DFA bank scans,
+post_match — so optimization goes to the real hot spot.
+"""
+
+import statistics
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=10, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts), out
+
+
+def main():
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs, synthetic_requests
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf, post_match
+    from coraza_kubernetes_operator_tpu.ops.dfa import scan_dfa_bank
+    from coraza_kubernetes_operator_tpu.ops.transforms import apply_device_pipeline
+
+    n_rules = 200
+    batch = 1024
+    engine = WafEngine(synthetic_crs(n_rules))
+    requests = synthetic_requests(batch, attack_ratio=0.1, seed=1)
+
+    t0 = time.perf_counter()
+    extractions = [engine.extractor.extract(r) for r in requests]
+    t_extract = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    tensors = engine._tensorize(extractions)
+    t_tensorize = time.perf_counter() - t0
+    (data, lengths, kind1, kind2, kind3, req_id, numvals, vdata, vlengths) = tensors
+
+    model = engine.model
+    print(f"host: extract={t_extract*1e3:.1f}ms tensorize={t_tensorize*1e3:.1f}ms")
+    print(
+        f"shapes: data={data.shape} vdata={vdata.shape} banks={len(model.banks)}"
+        f" n_rules={model.n_rules} links={model.ltype.shape}"
+    )
+    for i, (bank, pid) in enumerate(zip(model.banks, model.bank_pipelines)):
+        print(
+            f"  bank{i}: G={bank.n_groups} S={bank.n_states} C={bank.packed.shape[2]}"
+            f" pid={pid} device={model.pipeline_device[pid]}"
+        )
+
+    t_all, out = timeit(eval_waf, model, *tensors)
+    print(f"eval_waf total: {t_all*1e3:.1f} ms")
+
+    # Device transforms per pipeline.
+    transformed = {}
+    for pid in sorted(set(model.bank_pipelines)):
+        slot = model.host_variant_index[pid]
+        if slot >= 0:
+            transformed[pid] = (vdata[slot], vlengths[slot])
+            print(f"  pipeline {pid}: host variant")
+        else:
+            names = model.pipelines[pid]
+            f = jax.jit(lambda d, l: apply_device_pipeline(d, l, names))
+            t, res = timeit(f, data, lengths)
+            transformed[pid] = res
+            print(f"  pipeline {pid} {model.pipelines[pid]}: {t*1e3:.1f} ms")
+
+    group_hits = []
+    for i, (bank, pid) in enumerate(zip(model.banks, model.bank_pipelines)):
+        tdata, tlen = transformed[pid]
+        t, hits = timeit(scan_dfa_bank, bank, tdata, tlen)
+        group_hits.append(hits)
+        print(f"  scan bank{i}: {t*1e3:.1f} ms")
+
+    gh = jnp.concatenate(group_hits, axis=1)
+    f_post = jax.jit(partial(post_match, max_phase=2))
+    t, _ = timeit(f_post, model, gh, kind1, kind2, kind3, req_id, numvals)
+    print(f"  post_match: {t*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

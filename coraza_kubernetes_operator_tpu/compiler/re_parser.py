@@ -366,6 +366,12 @@ class _Parser:
         digits = first
         while len(digits) < 3 and (self.peek() or "") in "01234567":
             digits += self.next()
+        if first != "0" and len(digits) == 1:
+            # RE2 parse.cc: a single non-zero digit is a backreference,
+            # which RE2 (and therefore this engine) does not support —
+            # compiling it as octal would silently change what the rule
+            # matches, so fail loudly at compile time.
+            raise self.error(f"backreference \\{digits} not supported (RE2 subset)")
         val = int(digits, 8)
         if val > 0xFF:
             raise self.error(f"octal escape \\{digits} out of byte range")

@@ -65,6 +65,10 @@ class WafModel:
     # incidence [K+1, Rl]
     inc: jnp.ndarray
     exc: jnp.ndarray
+    # matmul-formulated constants (gathers serialize on TPU; these ride MXU)
+    e_lg: jnp.ndarray  # [G, Rl] int8 one-hot of lgroup
+    m_count: jnp.ndarray  # [Rl, Rr] int8: multiplicity of link l in rule r
+    link_count: jnp.ndarray  # [Rr] int32: number of links per rule
     # rule arrays [Rr]
     link_matrix: jnp.ndarray  # [Rr, MX]
     link_mask: jnp.ndarray  # [Rr, MX]
@@ -95,6 +99,9 @@ class WafModel:
             self.lcounter,
             self.inc,
             self.exc,
+            self.e_lg,
+            self.m_count,
+            self.link_count,
             self.link_matrix,
             self.link_mask,
             self.decision,
@@ -125,6 +132,15 @@ class WafModel:
     @property
     def n_counters(self) -> int:
         return int(self.counter_base.shape[0])
+
+
+def lgroup_onehot(lgroup: np.ndarray, n_groups: int) -> np.ndarray:
+    """[G, Rl] int8 one-hot of each link's group id — the post_match matmul
+    constant. Shared with the rule-sharded layout (``parallel/mesh.py``)."""
+    e_lg = np.zeros((n_groups, len(lgroup)), dtype=np.int8)
+    for i, g in enumerate(lgroup):
+        e_lg[g, i] = 1
+    return e_lg
 
 
 def build_model(crs: CompiledRuleSet) -> WafModel:
@@ -206,6 +222,15 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         padded[: weights.shape[0]] = weights
         weights = padded
 
+    # Matmul-formulated constants for post_match.
+    e_lg = lgroup_onehot(lgroup, max(1, len(crs.groups)))
+    m_count = np.zeros((rl, rr), dtype=np.int8)
+    link_count = np.zeros(rr, dtype=np.int32)
+    for i, rule in enumerate(crs.rules):
+        link_count[i] = len(rule.link_ids)
+        for lid in rule.link_ids:
+            m_count[lid, i] += 1
+
     return WafModel(
         banks=banks,
         ltype=jnp.asarray(ltype),
@@ -217,6 +242,9 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         lcounter=jnp.asarray(lcounter),
         inc=jnp.asarray(inc),
         exc=jnp.asarray(exc),
+        e_lg=jnp.asarray(e_lg),
+        m_count=jnp.asarray(m_count),
+        link_count=jnp.asarray(link_count),
         link_matrix=jnp.asarray(link_matrix),
         link_mask=jnp.asarray(link_mask),
         decision=jnp.asarray(decision),
@@ -302,11 +330,32 @@ def post_match(
     single-chip path and the sharded path (``parallel/mesh.py``), which
     arrives here after all-gathering rule-sharded group hits."""
     b = numvals.shape[0]
+    k = model.inc.shape[0]
 
-    # 3: incidence + per-target link matches.
-    gm = group_hits[:, model.lgroup]  # [T, Rl]
-    rel = model.inc[kind1] | model.inc[kind2] | model.inc[kind3]
-    excl = model.exc[kind1] | model.exc[kind2] | model.exc[kind3]
+    # 3: incidence + per-target link matches. All the T-sized lookups are
+    # one-hot int8 matmuls: XLA's gather lowering serializes on TPU while
+    # these contractions ride the MXU (measured ~100x on the same shapes).
+    gm = (
+        jnp.dot(
+            group_hits.astype(jnp.int8), model.e_lg,
+            preferred_element_type=jnp.int32,
+        )
+        > 0
+    )  # [T, Rl] == group_hits[:, lgroup]
+    kinds_iota = jnp.arange(k, dtype=jnp.int32)[None, :]
+    k_multi = (
+        (kind1[:, None] == kinds_iota)
+        | (kind2[:, None] == kinds_iota)
+        | (kind3[:, None] == kinds_iota)
+    ).astype(jnp.int8)  # [T, K]
+    rel = (
+        jnp.dot(k_multi, model.inc.astype(jnp.int8), preferred_element_type=jnp.int32)
+        > 0
+    )
+    excl = (
+        jnp.dot(k_multi, model.exc.astype(jnp.int8), preferred_element_type=jnp.int32)
+        > 0
+    )
     str_t = rel & ~excl & (gm ^ model.lneg[None, :])  # [T, Rl]
 
     # 4a: targets → requests. One-hot matmul instead of scatter: scatters
@@ -317,8 +366,8 @@ def post_match(
     m_str = (
         jnp.einsum(
             "tb,tr->br",
-            onehot.astype(jnp.int32),
-            str_t.astype(jnp.int32),
+            onehot.astype(jnp.int8),
+            str_t.astype(jnp.int8),
             preferred_element_type=jnp.int32,
         )
         > 0
@@ -339,14 +388,23 @@ def post_match(
     )  # counter links False in the prelim pass
 
     def rules_from_links(lm: jnp.ndarray) -> jnp.ndarray:
-        picked = lm[:, model.link_matrix]  # [B, Rr, MX]
-        picked = jnp.where(model.link_mask[None, :, :], picked, True)
-        return picked.all(axis=-1)  # [B, Rr]
+        # AND over a rule's links == "every selected link matched", computed
+        # as a multiplicity-count matmul (MXU) instead of a [B, Rr, MX]
+        # gather: count of matched links must equal the rule's link count.
+        counts = jnp.dot(
+            lm.astype(jnp.int8), model.m_count, preferred_element_type=jnp.int32
+        )  # [B, Rr]
+        return counts == model.link_count[None, :]
 
     prelim = rules_from_links(link_m)
 
-    # 4c: anomaly-score counters + threshold links.
-    counters = model.counter_base[None, :] + prelim.astype(jnp.int32) @ model.weights
+    # 4c: anomaly-score counters + threshold links. f32 matmul (exact for
+    # |weights| < 2^24) — an int32 matmul would not ride the MXU.
+    counters = model.counter_base[None, :] + jnp.dot(
+        prelim.astype(jnp.float32),
+        model.weights.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
     cvals = counters[:, model.lcounter]
     m_counter = _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :]) ^ model.lneg[None, :]
     link_m = jnp.where(lt == LINK_COUNTER, m_counter, link_m)

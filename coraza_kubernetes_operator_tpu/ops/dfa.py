@@ -1,15 +1,22 @@
 """Stacked-DFA batch scanner — the core matcher kernel.
 
-A bank stacks G compiled DFAs (``compiler/re_dfa.py``) into padded device
-tables and scans a ``[B, L]`` byte batch with ``lax.scan``:
+A bank stacks G compiled DFAs (``compiler/re_dfa.py``) into device tables and
+scans a ``[B, L]`` byte batch. Two formulations:
 
-    cls    = classmap[byte]                       # [B, G] gather
-    packed = trans[g, state, cls]                 # [B, G] gather
-    hit    = packed >> 30 ; state = packed & MASK
+- ``scan_dfa_bank`` (default): **gather-free matmul scan**. Per byte step the
+  byte one-hot ``[B, 256]`` is contracted with a dense per-slot transition
+  table ``[256, S*G]`` on the MXU, and the current-state one-hot selects the
+  per-group next state with a VPU reduce. XLA's gather lowering serializes on
+  TPU (~100M elem/s measured), while this rides the systolic array — the
+  difference is ~100x end-to-end. Entries pack ``next + S*emit`` so one
+  matmul yields both transition and match bit; dtype is int8 when the packed
+  values fit (S <= 64, int8 MXU), else bf16 (S <= 128, integers exact to
+  256), else f32.
+- ``scan_dfa_bank_gather``: the original two-gathers-per-byte formulation,
+  kept as the semantic oracle for differential tests and as the CPU path of
+  last resort.
 
-Two gathers per byte per (row, group). The transition and emit bits are
-packed into one int32 (state index < 2**30) to halve table reads. Long
-bodies stream through the same scan — NFA/DFA state is the natural carry,
+Long bodies stream through the same scan — DFA state is the natural carry,
 which is the blockwise "long context" decomposition (SURVEY §5): no
 cross-chip sequence parallelism is needed at WAF body sizes, the scan carry
 crosses block boundaries exactly.
@@ -42,9 +49,10 @@ class DFABank:
     classmap: jnp.ndarray  # [256, G] int32 (transposed for row gather)
     match_end: jnp.ndarray  # [G, S] bool
     always: jnp.ndarray  # [G] bool
+    t256: jnp.ndarray  # [256, S*G] dense: next + S*emit (slot j = s*G + g)
 
     def tree_flatten(self):
-        return (self.packed, self.classmap, self.match_end, self.always), None
+        return (self.packed, self.classmap, self.match_end, self.always, self.t256), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -59,15 +67,36 @@ class DFABank:
         return int(self.packed.shape[1])
 
 
-def stack_dfas(dfas: list[DFA]) -> DFABank:
-    """Stack DFAs into one padded bank (host-side, numpy)."""
+# Max padded state count for which the dense byte-indexed table is built.
+# Beyond this the packed value no longer fits narrow dtypes and the table
+# itself becomes a (256/C)x memory blow-up over the class-compressed form;
+# such banks scan via the classmap gather path instead.
+_DENSE_MAX_STATES = 128
+
+
+def _dense_dtype(s_max: int):
+    """(numpy dtype, cast-to-bf16-on-TPU) for packed values in [0, 2*s_max)."""
+    if 2 * s_max - 1 <= 127:
+        return np.int8, False
+    return np.float32, 2 * s_max - 1 <= 255  # bf16 holds integers <= 256 exactly
+
+
+def stack_dfas(dfas: list[DFA], min_states: int = 1) -> DFABank:
+    """Stack DFAs into one padded bank (host-side, numpy). ``min_states``
+    forces a larger state padding so shard banks can share one layout."""
     g = len(dfas)
-    s_max = max(d.n_states for d in dfas)
+    s_max = max(min_states, max(d.n_states for d in dfas))
     c_max = max(d.n_classes for d in dfas)
     packed = np.zeros((g, s_max, c_max), dtype=np.int32)
     classmap = np.zeros((256, g), dtype=np.int32)
     match_end = np.zeros((g, s_max), dtype=bool)
     always = np.zeros(g, dtype=bool)
+    build_dense = s_max <= _DENSE_MAX_STATES
+    # Dense byte-indexed table for the matmul/Pallas scan: for every byte
+    # value and (state, group) slot, the packed next-state + S*emit. Padded
+    # states (s >= d.n_states) self-loop to 0 and never activate (state
+    # one-hot starts at local state 0 and transitions stay in range).
+    dense = np.zeros((256, s_max if build_dense else 0, g), dtype=np.int32)
     for i, d in enumerate(dfas):
         s, c = d.n_states, d.n_classes
         packed[i, :s, :c] = d.trans.astype(np.int32) | (
@@ -76,20 +105,130 @@ def stack_dfas(dfas: list[DFA]) -> DFABank:
         classmap[:, i] = d.classmap
         match_end[i, :s] = d.match_end
         always[i] = d.always_match
+        if build_dense:
+            per_byte_next = d.trans[:, d.classmap]  # [S, 256]
+            per_byte_emit = d.emit[:, d.classmap]  # [S, 256]
+            dense[:, :s, i] = (
+                per_byte_next + s_max * per_byte_emit.astype(np.int32)
+            ).T
+    t256 = dense.reshape(256, dense.shape[1] * g)
+    dt, to_bf16 = _dense_dtype(s_max)
+    t256_j = jnp.asarray(t256.astype(dt))
+    if to_bf16 and jax.default_backend() == "tpu":
+        t256_j = t256_j.astype(jnp.bfloat16)
     return DFABank(
         packed=jnp.asarray(packed),
         classmap=jnp.asarray(classmap),
         match_end=jnp.asarray(match_end),
         always=jnp.asarray(always),
+        t256=t256_j,
     )
 
 
-@partial(jax.jit, static_argnames=())
+# VMEM budget for the Pallas kernel's resident working set (table + per-step
+# accumulator tiles at block_b=128). Banks above this run the XLA take-scan.
+_PALLAS_VMEM_BUDGET = 11 * 2**20
+_PALLAS_BLOCK_B = 128
+
+
+def _pallas_vmem_bytes(s: int, g: int, itemsize: int, length: int) -> int:
+    gp = (g + 127) // 128 * 128
+    table = 256 * s * gp * itemsize
+    # per-step [block_b, S*Gp] accumulator + one fused select intermediate
+    work = _PALLAS_BLOCK_B * s * gp * 4 * 2
+    data_tile = length * _PALLAS_BLOCK_B * 4  # [L, block_b] int32 block
+    return table + work + data_tile
+
+
 def scan_dfa_bank(
     bank: DFABank, data: jnp.ndarray, lengths: jnp.ndarray
 ) -> jnp.ndarray:
     """Scan ``data`` [B, L] uint8 (zero-padded past ``lengths`` [B]) against
-    every DFA in the bank. Returns ``matched`` [B, G] bool."""
+    every DFA in the bank. Returns ``matched`` [B, G] bool.
+
+    Dispatch: Pallas VMEM-resident kernel on TPU when the dense table and
+    working set fit VMEM (``ops/dfa_pallas.py``); XLA dense-row take-scan
+    when a dense table exists; classmap gather scan for huge-state banks
+    (no dense table — it would be a (256/C)x memory blow-up)."""
+    if bank.t256.size == 0:
+        return scan_dfa_bank_gather(bank, data, lengths)
+    fits = (
+        _pallas_vmem_bytes(
+            bank.n_states, bank.n_groups, bank.t256.dtype.itemsize, data.shape[1]
+        )
+        <= _PALLAS_VMEM_BUDGET
+    )
+    if jax.default_backend() == "tpu" and fits:
+        from .dfa_pallas import scan_dfa_bank_pallas
+
+        return scan_dfa_bank_pallas(
+            bank.t256,
+            bank.match_end.T,
+            bank.always,
+            data,
+            lengths,
+            s=bank.n_states,
+            g=bank.n_groups,
+            block_b=_PALLAS_BLOCK_B,
+        )
+    return scan_dfa_bank_take(bank, data, lengths)
+
+
+@partial(jax.jit, static_argnames=())
+def scan_dfa_bank_take(
+    bank: DFABank, data: jnp.ndarray, lengths: jnp.ndarray
+) -> jnp.ndarray:
+    """XLA formulation: per byte step a row-gather from the dense table
+    (``take``) and a VPU state-select. Correct everywhere, but materializes
+    a [B, S*G] intermediate in HBM per step — the Pallas kernel exists to
+    keep that tile in VMEM. (A one-hot @ table matmul inside ``lax.scan``
+    is NOT used: XLA miscompiles it at batch ~4096-5000, identically on CPU
+    and TPU; see tests/test_dfa_kernel.py.)"""
+    b, length = data.shape
+    g = bank.n_groups
+    s = bank.n_states
+
+    state_iota = jnp.arange(s, dtype=jnp.int32)[None, :, None]  # [1, S, 1]
+
+    # Derive the zero init from the inputs so the carry inherits their
+    # varying-manual-axes property under shard_map (a plain jnp.zeros is
+    # 'unvarying' and lax.scan rejects the carry type mismatch). Both the
+    # data (data-sharded) and the tables (rule-sharded) contribute axes.
+    row0 = (
+        data[:, :1].astype(jnp.int32) * 0 + bank.t256[:1, :1].astype(jnp.int32) * 0
+    )  # [B, 1] varying zero
+    zero2 = row0 + jnp.zeros((b, g), dtype=jnp.int32)  # [B, G]
+    init = (zero2, zero2 != 0, zero2)
+
+    def step(carry, xs):
+        t, byte_col = xs
+        state, matched, end_state = carry
+        r = jnp.take(bank.t256, byte_col.astype(jnp.int32), axis=0)
+        r = r.astype(jnp.int32).reshape(b, s, g)
+        sigma = state[:, None, :] == state_iota  # [B, S, G] bool
+        val = jnp.sum(jnp.where(sigma, r, 0), axis=1).astype(jnp.int32)  # [B, G]
+        hit = val >= s
+        nxt = val - s * hit.astype(jnp.int32)
+        active = (t < lengths)[:, None]  # [B, 1]
+        matched = matched | (hit & active)
+        state = jnp.where(active, nxt, state)
+        end_state = jnp.where((t == lengths - 1)[:, None], state, end_state)
+        return (state, matched, end_state), None
+
+    ts = jnp.arange(length, dtype=jnp.int32)
+    (state, matched, end_state), _ = jax.lax.scan(step, init, (ts, data.T))
+    end_sigma = end_state[:, None, :] == state_iota  # [B, S, G]
+    end_match = jnp.any(end_sigma & bank.match_end.T[None, :, :], axis=1)
+    matched = matched | end_match
+    matched = matched | bank.always[None, :]
+    return matched
+
+
+@partial(jax.jit, static_argnames=())
+def scan_dfa_bank_gather(
+    bank: DFABank, data: jnp.ndarray, lengths: jnp.ndarray
+) -> jnp.ndarray:
+    """Original gather-per-byte formulation — differential-test oracle."""
     b = data.shape[0]
     g = bank.n_groups
     garange = jnp.arange(g, dtype=jnp.int32)[None, :]  # [1, G]
@@ -106,10 +245,6 @@ def scan_dfa_bank(
         end_state = jnp.where((t == lengths - 1)[:, None], state, end_state)
         return (state, matched, end_state), None
 
-    # Derive the zero init from the inputs so the carry inherits their
-    # varying-manual-axes property under shard_map (a plain jnp.zeros is
-    # 'unvarying' and lax.scan rejects the carry type mismatch). Both the
-    # data (data-sharded) and the tables (rule-sharded) contribute axes.
     row0 = (
         data[:, :1].astype(jnp.int32) * 0 + bank.packed[0, 0, 0] * 0
     )  # [B, 1] varying zero

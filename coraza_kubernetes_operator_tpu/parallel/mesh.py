@@ -23,7 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..compiler.re_dfa import DFA
 from ..compiler.ruleset import CompiledRuleSet
-from ..models.waf_model import WafModel, build_model, post_match
+from ..models.waf_model import WafModel, build_model, lgroup_onehot, post_match
 from ..ops.dfa import DFABank, scan_dfa_bank, stack_dfas
 from ..ops.transforms import apply_device_pipeline
 
@@ -47,27 +47,28 @@ def _never_dfa() -> DFA:
     )
 
 
-def _stack_shard_banks(shard_banks: list[DFABank]) -> DFABank:
-    """Stack per-shard banks (equal G) onto a leading shard axis, padding
-    S/C to the max across shards."""
-    s_max = max(b.packed.shape[1] for b in shard_banks)
+def _stack_shard_banks(shard_dfas: list[list[DFA]]) -> DFABank:
+    """Build per-shard banks with a common [S, C] layout (so the dense
+    matmul tables share one shape and packing multiplier), then stack every
+    leaf onto a leading shard axis."""
+    s_max = max(d.n_states for dfas in shard_dfas for d in dfas)
+    shard_banks = [stack_dfas(dfas, min_states=s_max) for dfas in shard_dfas]
     c_max = max(b.packed.shape[2] for b in shard_banks)
-    g = shard_banks[0].packed.shape[0]
 
-    def pad(b: DFABank):
-        packed = np.zeros((g, s_max, c_max), dtype=np.int32)
-        p = np.asarray(b.packed)
-        packed[:, : p.shape[1], : p.shape[2]] = p
-        match_end = np.zeros((g, s_max), dtype=bool)
-        match_end[:, : b.match_end.shape[1]] = np.asarray(b.match_end)
-        return packed, np.asarray(b.classmap), match_end, np.asarray(b.always)
+    def pad_c(b: DFABank):
+        packed = np.asarray(b.packed)
+        if packed.shape[2] < c_max:
+            packed = np.pad(
+                packed, ((0, 0), (0, 0), (0, c_max - packed.shape[2]))
+            )
+        return packed
 
-    parts = [pad(b) for b in shard_banks]
     return DFABank(
-        packed=jnp.asarray(np.stack([p[0] for p in parts])),  # [R, G, S, C]
-        classmap=jnp.asarray(np.stack([p[1] for p in parts])),  # [R, 256, G]
-        match_end=jnp.asarray(np.stack([p[2] for p in parts])),  # [R, G, S]
-        always=jnp.asarray(np.stack([p[3] for p in parts])),  # [R, G]
+        packed=jnp.asarray(np.stack([pad_c(b) for b in shard_banks])),
+        classmap=jnp.asarray(np.stack([np.asarray(b.classmap) for b in shard_banks])),
+        match_end=jnp.asarray(np.stack([np.asarray(b.match_end) for b in shard_banks])),
+        always=jnp.asarray(np.stack([np.asarray(b.always) for b in shard_banks])),
+        t256=jnp.stack([b.t256 for b in shard_banks]),
     )
 
 
@@ -105,7 +106,7 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
     offset = 0
     for (pid, _bucket), gids in sorted(buckets.items()):
         width = max(1, math.ceil(len(gids) / n_rule_shards))
-        shard_banks = []
+        shard_dfas = []
         for s in range(n_rule_shards):
             chunk = gids[s * width : (s + 1) * width]
             dfas = [crs.groups[g].dfa for g in chunk]
@@ -113,16 +114,18 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
             for j, g in enumerate(chunk):
                 # Gathered layout: bucket-major, then shard, then slot.
                 remap[g] = offset + s * width + j
-            shard_banks.append(stack_dfas(dfas))
-        banks.append(_stack_shard_banks(shard_banks))
+            shard_dfas.append(dfas)
+        banks.append(_stack_shard_banks(shard_dfas))
         bank_pipelines.append(pid)
         bucket_widths.append(width)
         offset += n_rule_shards * width
 
     # lgroup in the ORIGINAL compiled link order, remapped to gathered ids.
-    lgroup = np.zeros(int(base.lgroup.shape[0]), dtype=np.int32)
+    rl = int(base.lgroup.shape[0])
+    lgroup = np.zeros(rl, dtype=np.int32)
     for i, link in enumerate(crs.links):
         lgroup[i] = remap[link.group] if link.group >= 0 else 0
+    e_lg = lgroup_onehot(lgroup, max(1, offset))
 
     post = WafModel(
         banks=[],
@@ -135,6 +138,9 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
         lcounter=base.lcounter,
         inc=base.inc,
         exc=base.exc,
+        e_lg=jnp.asarray(e_lg),
+        m_count=base.m_count,
+        link_count=base.link_count,
         link_matrix=base.link_matrix,
         link_mask=base.link_mask,
         decision=base.decision,

@@ -84,3 +84,89 @@ def test_scan_zero_length_rows():
     matched = np.asarray(scan_dfa_bank(bank, data, lengths))
     for g, dfa in enumerate(dfas):
         assert (matched[:, g] == dfa.search(b"")).all()
+
+
+def _random_batch(n, max_len, seed=3):
+    rng = random.Random(seed)
+    data = np.zeros((n, max_len), dtype=np.uint8)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        c = bytes(
+            rng.choice(b"abcdefor1=' <>script/untilfwm")
+            for _ in range(rng.randrange(0, max_len))
+        )
+        data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        lengths[i] = len(c)
+    return data, lengths
+
+
+def test_take_and_gather_formulations_agree():
+    from coraza_kubernetes_operator_tpu.ops.dfa import (
+        scan_dfa_bank_gather,
+        scan_dfa_bank_take,
+    )
+
+    _, bank = _bank()
+    data, lengths = _random_batch(64, 48)
+    m_take = np.asarray(
+        scan_dfa_bank_take(bank, jnp.asarray(data), jnp.asarray(lengths))
+    )
+    m_gather = np.asarray(
+        scan_dfa_bank_gather(bank, jnp.asarray(data), jnp.asarray(lengths))
+    )
+    assert (m_take == m_gather).all()
+
+
+def test_pallas_kernel_interpret_matches_oracle():
+    """The TPU kernel, run in interpreter mode, agrees with the scalar DFA."""
+    from coraza_kubernetes_operator_tpu.ops.dfa_pallas import scan_dfa_bank_pallas
+
+    dfas, bank = _bank()
+    data, lengths = _random_batch(16, 32, seed=11)
+    matched = np.asarray(
+        scan_dfa_bank_pallas(
+            bank.t256,
+            bank.match_end.T,
+            bank.always,
+            jnp.asarray(data),
+            jnp.asarray(lengths),
+            s=bank.n_states,
+            g=bank.n_groups,
+            interpret=True,
+        )
+    )
+    for i in range(data.shape[0]):
+        raw = bytes(data[i, : lengths[i]])
+        for g, dfa in enumerate(dfas):
+            assert matched[i, g] == dfa.search(raw), (raw, PATTERNS[g])
+
+
+def test_matmul_scan_xla_miscompile_guard():
+    """Regression guard for the XLA bug that forced the `take` formulation.
+
+    A one-hot @ table matmul *inside* ``lax.scan`` returns wrong results at
+    batch sizes ~4000-5000 (bisected: wrong at 4000-5000, correct at 3072 and
+    8192; identical on XLA:CPU and XLA:TPU; correct when the identical step
+    runs outside the loop). The shipped take-scan must stay correct at those
+    shapes. This exercises B=4096 directly.
+    """
+    from coraza_kubernetes_operator_tpu.ops.dfa import scan_dfa_bank_take
+
+    dfas, bank = _bank()
+    data, lengths = _random_batch(4096, 24, seed=5)
+    # Call the take formulation directly: the dispatcher would route to the
+    # Pallas kernel on TPU and never exercise the path this test guards.
+    matched = np.asarray(
+        scan_dfa_bank_take(bank, jnp.asarray(data), jnp.asarray(lengths))
+    )
+    for i in (0, 1, 17, 4095):
+        raw = bytes(data[i, : lengths[i]])
+        for g, dfa in enumerate(dfas):
+            assert matched[i, g] == dfa.search(raw), (raw, PATTERNS[g])
+    # spot-check aggregate: every column equals the oracle column
+    for g, dfa in enumerate(dfas):
+        ref = np.fromiter(
+            (dfa.search(bytes(data[i, : lengths[i]])) for i in range(0, 4096, 37)),
+            dtype=bool,
+        )
+        assert (matched[::37, g] == ref).all(), PATTERNS[g]

@@ -249,6 +249,18 @@ def test_octal_escapes():
         compile_regex_dfa(r"\777")  # > 0xFF
 
 
+def test_single_nonzero_digit_escape_is_backreference_error():
+    # RE2 parse.cc: \1 alone is an (unsupported) backreference, not octal —
+    # compiling it as octal would silently change what a rule matches.
+    with pytest.raises(RegexParseError):
+        compile_regex_dfa(r"(select)\1")
+    with pytest.raises(RegexParseError):
+        compile_regex_dfa(r"[\1]")
+    # \0 alone and multi-digit forms stay octal.
+    assert compile_regex_dfa(r"\12x").search(b"\nx")
+    assert compile_regex_dfa(r"[\12]").search(b"\n")
+
+
 def test_invalid_hex_escape_raises_parse_error():
     with pytest.raises(RegexParseError):
         compile_regex_dfa(r"\x{zz}")
