@@ -1,0 +1,99 @@
+# Build/test/conformance pipeline — target parity with the reference
+# Makefile (build, codegen-drift, lint, unit/integration tests, kind
+# cluster, CRS download + ConfigMap generation, ftw pipeline, helm sync).
+
+PYTHON ?= python
+KIND_CLUSTER_NAME ?= coraza-tpu
+CORERULESET_VERSION ?= v4.23.0
+CORERULESET_URL ?= https://github.com/coreruleset/coreruleset/archive/refs/tags/$(CORERULESET_VERSION).tar.gz
+BUILD_DIR ?= build
+IMG ?= ghcr.io/coraza-tpu/coraza-kubernetes-operator-tpu:latest
+
+.PHONY: all
+all: test
+
+# -- build --------------------------------------------------------------------
+
+.PHONY: build
+build:  ## Byte-compile the package (no native build step required).
+	$(PYTHON) -m compileall -q coraza_kubernetes_operator_tpu
+
+.PHONY: docker.build
+docker.build:
+	docker build -t $(IMG) .
+
+# -- tests --------------------------------------------------------------------
+
+.PHONY: test test.unit
+test test.unit:  ## Unit + kernel + controller tests on the virtual CPU mesh.
+	$(PYTHON) -m pytest tests/ -x -q
+
+.PHONY: test.integration
+test.integration:  ## In-process integration scenarios (cache+sidecar+controllers).
+	$(PYTHON) -m pytest tests/test_engine_e2e.py tests/test_sidecar.py tests/test_ftw.py -q
+
+.PHONY: bench
+bench:  ## One-line JSON throughput/latency benchmark (TPU if available).
+	$(PYTHON) bench.py
+
+.PHONY: lint
+lint:
+	$(PYTHON) -m compileall -q coraza_kubernetes_operator_tpu tests ftw hack tools
+
+# -- conformance (ftw) --------------------------------------------------------
+
+.PHONY: ftw
+ftw:  ## Replay the bundled go-ftw corpus in-process, honoring ftw/ftw.yml.
+	$(PYTHON) ftw/run.py
+
+.PHONY: ftw.coreruleset
+ftw.coreruleset: coreruleset.download  ## CRS -> ConfigMaps + RuleSet manifests.
+	$(PYTHON) hack/generate_coreruleset_configmaps.py \
+		--crs-dir $(BUILD_DIR)/coreruleset --out-dir $(BUILD_DIR)/crs-manifests \
+		--include-test-rule --ignore-pmFromFile
+
+.PHONY: coreruleset.download
+coreruleset.download:
+	mkdir -p $(BUILD_DIR)
+	test -d $(BUILD_DIR)/coreruleset || ( \
+		curl -sSL $(CORERULESET_URL) -o $(BUILD_DIR)/crs.tar.gz && \
+		mkdir -p $(BUILD_DIR)/coreruleset && \
+		tar -xzf $(BUILD_DIR)/crs.tar.gz -C $(BUILD_DIR)/coreruleset --strip-components=1 )
+
+# -- cluster ------------------------------------------------------------------
+
+.PHONY: cluster.kind
+cluster.kind:  ## kind + Gateway API CRDs + operator (hack/kind_cluster.py).
+	$(PYTHON) hack/kind_cluster.py setup --name $(KIND_CLUSTER_NAME)
+
+.PHONY: cluster.kind.delete
+cluster.kind.delete:
+	$(PYTHON) hack/kind_cluster.py delete --name $(KIND_CLUSTER_NAME)
+
+.PHONY: deploy
+deploy:  ## Apply CRDs + RBAC + manager via kustomize.
+	kubectl apply --server-side -k config/default
+
+.PHONY: undeploy
+undeploy:
+	kubectl delete -k config/default --ignore-not-found
+
+# -- helm ---------------------------------------------------------------------
+
+.PHONY: helm.sync-crds
+helm.sync-crds:  ## Copy generated CRDs into the chart (reference Makefile:263-265).
+	cp config/crd/bases/*.yaml charts/coraza-kubernetes-operator-tpu/crds/
+
+.PHONY: helm.lint
+helm.lint:
+	helm lint charts/coraza-kubernetes-operator-tpu
+
+# -- native -------------------------------------------------------------------
+
+.PHONY: native
+native:  ## Build the C++ host runtime (request tensorizer).
+	$(MAKE) -C native
+
+.PHONY: help
+help:
+	@grep -E '^[a-zA-Z_.-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'

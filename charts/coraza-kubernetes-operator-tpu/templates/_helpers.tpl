@@ -1,0 +1,18 @@
+{{- define "cko-tpu.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "cko-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "cko-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "cko-tpu.envoyClusterName" -}}
+{{- if .Values.envoyClusterName -}}
+{{- .Values.envoyClusterName -}}
+{{- else -}}
+outbound|80||{{ include "cko-tpu.name" . }}-controller-manager.{{ .Release.Namespace }}.svc.cluster.local
+{{- end -}}
+{{- end -}}

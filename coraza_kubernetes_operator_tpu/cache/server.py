@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..observability import MetricsRegistry
 from ..utils import get_logger
 from .cache import RuleSetCache, format_timestamp
 
@@ -75,6 +76,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(431, "Request header too large")
             return
         path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            metrics: MetricsRegistry = self.server.metrics  # type: ignore[attr-defined]
+            self._reply(
+                200,
+                metrics.render().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
         if not path.startswith("/rules/"):
             self._error(404, "Not found")
             return
@@ -82,6 +91,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not key:
             self._error(400, "RuleSet key required")
             return
+        self.server.m_requests.inc(  # type: ignore[attr-defined]
+            endpoint="latest" if key.endswith("/latest") else "rules"
+        )
         if key.endswith("/latest"):
             self._handle_latest(key[: -len("/latest")])
         else:
@@ -134,8 +146,23 @@ class RuleSetCacheServer:
     ):
         self.cache = cache
         self.gc = gc or GarbageCollectionConfig()
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "ruleset_cache_requests_total", "Cache endpoint hits", ("endpoint",)
+        )
+        self._m_pruned = self.metrics.counter(
+            "ruleset_cache_pruned_total", "GC-pruned entries", ("reason",)
+        )
+        self.metrics.gauge(
+            "ruleset_cache_bytes", "Total cached rule bytes"
+        ).set_function(cache.total_size)
+        self.metrics.gauge(
+            "ruleset_cache_keys", "Distinct cached ruleset keys"
+        ).set_function(lambda: float(len(cache.list_keys())))
         self._httpd = _Server((host, port), _Handler)
         self._httpd.cache = cache  # type: ignore[attr-defined]
+        self._httpd.metrics = self.metrics  # type: ignore[attr-defined]
+        self._httpd.m_requests = self._m_requests  # type: ignore[attr-defined]
         self._serve_thread: threading.Thread | None = None
         self._gc_stop = threading.Event()
         self._gc_thread: threading.Thread | None = None
@@ -173,6 +200,7 @@ class RuleSetCacheServer:
         while not self._gc_stop.wait(interval):
             pruned_by_age = self.cache.prune(self.gc.max_age)
             if pruned_by_age:
+                self._m_pruned.inc(pruned_by_age, reason="age")
                 log.info(
                     "Pruned stale cache entries by age",
                     count=pruned_by_age,
@@ -182,6 +210,7 @@ class RuleSetCacheServer:
             if current > self.gc.max_size:
                 pruned_by_size = self.cache.prune_by_size(self.gc.max_size)
                 if pruned_by_size:
+                    self._m_pruned.inc(pruned_by_size, reason="size")
                     log.info(
                         "Pruned cache entries by size",
                         count=pruned_by_size,

@@ -1,0 +1,365 @@
+"""Operator entrypoint — the reference ``cmd/main.go`` analog.
+
+Wires together, with the same flag surface (reference ``cmd/main.go:71-238``):
+
+- the versioned RuleSet cache + HTTP cache server with GC knobs
+  (``--cache-server-port``, ``--cache-gc-interval/-max-age/-max-size``);
+- both controllers via the ControllerManager (requires
+  ``--envoy-cluster-name`` exactly like the reference refuses to start
+  without it);
+- health (``/healthz``, ``/readyz``) and Prometheus ``/metrics`` servers;
+- a leader-election gate (``--leader-elect``) — in-cluster this should be
+  backed by a Lease; standalone it is a no-op latch.
+
+Object source: ``--manifest-dir`` loads ConfigMap / RuleSet / Engine YAML
+manifests into the watch-capable object store and re-scans on mtime change,
+standing in for the kube-apiserver watch stream when running outside a
+cluster (the same seam the in-memory envtest-analog tests use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import signal
+import sys
+import threading
+import time
+from datetime import timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import yaml
+
+from ..cache import RuleSetCache, RuleSetCacheServer
+from ..cache.server import (
+    CACHE_GC_INTERVAL,
+    CACHE_MAX_AGE,
+    CACHE_MAX_SIZE,
+    DEFAULT_CACHE_SERVER_PORT,
+    GarbageCollectionConfig,
+)
+from ..controlplane.api_types import (
+    ConfigMap,
+    DriverConfig,
+    Engine,
+    EngineSpec,
+    IstioDriverConfig,
+    IstioWasmConfig,
+    ObjectMeta,
+    RuleSet,
+    RuleSetCacheServerConfig,
+    RuleSetReference,
+    RuleSetSpec,
+    RuleSourceReference,
+    TpuDriverConfig,
+)
+from ..controlplane.manager import ControllerManager
+from ..controlplane.store import ObjectStore
+from ..utils import get_logger
+
+log = get_logger("cmd.operator")
+
+
+def parse_duration(text: str) -> timedelta:
+    """Go-style durations: 3s, 5m, 24h, 1h30m."""
+    m = re.fullmatch(r"(?:(\d+)h)?(?:(\d+)m)?(?:(\d+)s)?", text.strip())
+    if not m or not any(m.groups()):
+        raise argparse.ArgumentTypeError(f"invalid duration {text!r}")
+    h, mi, s = (int(g) if g else 0 for g in m.groups())
+    return timedelta(hours=h, minutes=mi, seconds=s)
+
+
+# -- manifest loading ---------------------------------------------------------
+
+
+def object_from_manifest(doc: dict):
+    kind = doc.get("kind")
+    meta_doc = doc.get("metadata", {}) or {}
+    meta = ObjectMeta(
+        name=meta_doc.get("name", ""),
+        namespace=meta_doc.get("namespace", "default"),
+        labels=meta_doc.get("labels", {}) or {},
+        annotations=meta_doc.get("annotations", {}) or {},
+    )
+    spec = doc.get("spec", {}) or {}
+    if kind == "ConfigMap":
+        return ConfigMap(metadata=meta, data=doc.get("data", {}) or {})
+    if kind == "RuleSet":
+        return RuleSet(
+            metadata=meta,
+            spec=RuleSetSpec(
+                rules=[
+                    RuleSourceReference(name=r.get("name", ""))
+                    for r in spec.get("rules", [])
+                ]
+            ),
+        )
+    if kind == "Engine":
+        driver_doc = spec.get("driver", {}) or {}
+        driver = DriverConfig()
+        if "istio" in driver_doc:
+            wasm = (driver_doc["istio"] or {}).get("wasm", {}) or {}
+            cache_cfg = wasm.get("ruleSetCacheServer")
+            driver.istio = IstioDriverConfig(
+                wasm=IstioWasmConfig(
+                    image=wasm.get("image", ""),
+                    mode=wasm.get("mode", "gateway"),
+                    workload_selector=wasm.get("workloadSelector"),
+                    rule_set_cache_server=(
+                        RuleSetCacheServerConfig(
+                            poll_interval_seconds=int(
+                                cache_cfg.get("pollIntervalSeconds", 15)
+                            )
+                        )
+                        if cache_cfg
+                        else None
+                    ),
+                )
+            )
+        if "tpu" in driver_doc:
+            tpu = driver_doc["tpu"] or {}
+            cache_cfg = tpu.get("ruleSetCacheServer")
+            driver.tpu = TpuDriverConfig(
+                image=tpu.get("image", TpuDriverConfig.image),
+                replicas=int(tpu.get("replicas", 1)),
+                max_batch_size=int(tpu.get("maxBatchSize", 2048)),
+                max_batch_delay_ms=int(tpu.get("maxBatchDelayMs", 2)),
+                rule_set_cache_server=(
+                    RuleSetCacheServerConfig(
+                        poll_interval_seconds=int(
+                            cache_cfg.get("pollIntervalSeconds", 15)
+                        )
+                    )
+                    if cache_cfg
+                    else None
+                ),
+            )
+        return Engine(
+            metadata=meta,
+            spec=EngineSpec(
+                rule_set=RuleSetReference(
+                    name=(spec.get("ruleSet", {}) or {}).get("name", "")
+                ),
+                driver=driver,
+                failure_policy=spec.get("failurePolicy", "fail"),
+            ),
+        )
+    return None  # kinds we do not manage (Gateways etc.) are skipped
+
+
+class ManifestSource:
+    """Loads CR manifests from a directory into the store; rescans on
+    mtime change — the out-of-cluster stand-in for API-server watches."""
+
+    def __init__(self, store: ObjectStore, directory: Path, interval_s: float = 2.0):
+        self.store = store
+        self.directory = directory
+        self.interval_s = interval_s
+        self._known: dict[tuple, int] = {}  # (kind, ns, name) -> content hash
+        self._file_keys: dict[Path, set[tuple]] = {}  # file -> its object keys
+        self._file_stat: dict[Path, tuple[int, int]] = {}  # file -> (mtime_ns, size)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sync_once(self) -> int:
+        count = 0
+        seen: set[tuple] = set()
+        for path in sorted(self.directory.rglob("*.y*ml")):
+            try:
+                st = path.stat()
+                sig = (st.st_mtime_ns, st.st_size)
+                if self._file_stat.get(path) == sig:
+                    # unchanged on disk: keep its objects without re-parsing
+                    seen |= self._file_keys.get(path, set())
+                    continue
+                docs = list(yaml.safe_load_all(path.read_text()))
+            except (OSError, yaml.YAMLError) as err:
+                # A transient read/parse failure (e.g. a non-atomic write in
+                # progress) must NOT read as absence — keep the file's known
+                # objects alive and retry next scan.
+                log.error("skipping unreadable manifest", err, path=str(path))
+                seen |= self._file_keys.get(path, set())
+                continue
+            file_keys: set[tuple] = set()
+            for doc in docs:
+                if not isinstance(doc, dict):
+                    continue
+                obj = object_from_manifest(doc)
+                if obj is None:
+                    continue
+                key = (obj.kind, obj.metadata.namespace, obj.metadata.name)
+                seen.add(key)
+                file_keys.add(key)
+                digest = hash(repr(doc))
+                if self._known.get(key) == digest:
+                    continue
+                existing = self.store.try_get(*key)
+                if existing is None:
+                    self.store.create(obj)
+                else:
+                    obj.metadata.uid = existing.metadata.uid
+                    obj.metadata.resource_version = existing.metadata.resource_version
+                    obj.metadata.generation = existing.metadata.generation
+                    self.store.update(obj)
+                self._known[key] = digest
+                count += 1
+            self._file_keys[path] = file_keys
+            self._file_stat[path] = sig
+        for path in [p for p in self._file_keys if not p.exists()]:
+            del self._file_keys[path]
+            self._file_stat.pop(path, None)
+        for key in [k for k in self._known if k not in seen]:
+            del self._known[key]
+            try:
+                self.store.delete(*key)
+            except KeyError:
+                pass
+        return count
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sync_once()
+            except Exception as err:  # keep watching despite bad manifests
+                log.error("manifest rescan failed", err)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+# -- health/metrics servers ---------------------------------------------------
+
+
+class _ProbeHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        ready_fn = self.server.ready_fn  # type: ignore[attr-defined]
+        metrics = self.server.metrics  # type: ignore[attr-defined]
+        if path == "/healthz":
+            body, code = b"ok\n", 200
+        elif path == "/readyz":
+            ok = ready_fn()
+            body, code = (b"ok\n", 200) if ok else (b"not ready\n", 503)
+        elif path == "/metrics" and metrics is not None:
+            body, code = metrics.render().encode(), 200
+        else:
+            body, code = b"not found\n", 404
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _serve(addr: str, ready_fn, metrics=None) -> ThreadingHTTPServer:
+    host, _, port = addr.rpartition(":")
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _ProbeHandler)
+    srv.ready_fn = ready_fn  # type: ignore[attr-defined]
+    srv.metrics = metrics  # type: ignore[attr-defined]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+# -- main ---------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="operator", description=__doc__)
+    p.add_argument("--envoy-cluster-name", required=True,
+                   help="Envoy cluster through which the mesh reaches the cache server")
+    p.add_argument("--cache-server-port", type=int, default=DEFAULT_CACHE_SERVER_PORT)
+    p.add_argument("--cache-gc-interval", type=parse_duration,
+                   default=CACHE_GC_INTERVAL)
+    p.add_argument("--cache-max-age", type=parse_duration, default=CACHE_MAX_AGE)
+    p.add_argument("--cache-max-size", type=int, default=CACHE_MAX_SIZE)
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--metrics-bind-address", default="",
+                   help="empty disables the metrics endpoint (reference default)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--manifest-dir", default="",
+                   help="directory of CR manifests (out-of-cluster object source)")
+    p.add_argument("--workers", type=int, default=2)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    store = ObjectStore()
+    cache = RuleSetCache()
+    cache_server = RuleSetCacheServer(
+        cache,
+        port=args.cache_server_port,
+        gc=GarbageCollectionConfig(
+            gc_interval=args.cache_gc_interval,
+            max_age=args.cache_max_age,
+            max_size=args.cache_max_size,
+        ),
+    )
+    manager = ControllerManager(
+        store,
+        cache,
+        cache_server_cluster=args.envoy_cluster_name,
+        cache_server_port=args.cache_server_port,
+        workers=args.workers,
+    )
+
+    source: ManifestSource | None = None
+    if args.manifest_dir:
+        source = ManifestSource(store, Path(args.manifest_dir))
+
+    ready = threading.Event()
+    probe_srv = _serve(args.health_probe_bind_address, ready.is_set)
+    metrics_srv = None
+    if args.metrics_bind_address:
+        metrics_srv = _serve(
+            args.metrics_bind_address, ready.is_set, cache_server.metrics
+        )
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    if args.leader_elect:
+        # Standalone latch; in-cluster deployments back this with a Lease.
+        log.info("leader election enabled (standalone latch acquired)")
+
+    cache_server.start()
+    manager.start()
+    if source is not None:
+        source.sync_once()
+        source.start()
+    ready.set()
+    log.info(
+        "operator started",
+        cachePort=cache_server.port,
+        probes=args.health_probe_bind_address,
+        metrics=args.metrics_bind_address or "(disabled)",
+        manifestDir=args.manifest_dir or "(none)",
+    )
+    stop.wait()
+    ready.clear()
+    if source is not None:
+        source.stop()
+    manager.stop()
+    cache_server.stop()
+    for srv in (probe_srv, metrics_srv):
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+    log.info("operator stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

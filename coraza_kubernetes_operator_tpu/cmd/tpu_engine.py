@@ -57,6 +57,18 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
     )
     p.add_argument("--bind-address", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9090)
+    p.add_argument(
+        "--audit-log",
+        default="",
+        help="audit log destination: '-' for stdout (SecAuditLog /dev/stdout"
+        " parity), a file path, or empty to disable",
+    )
+    p.add_argument(
+        "--audit-all",
+        action="store_true",
+        help="log every transaction, not just matches (SecAuditEngine On"
+        " instead of RelevantOnly)",
+    )
     args = p.parse_args(argv)
 
     cluster = args.cache_server_cluster
@@ -73,6 +85,8 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         max_batch_delay_ms=args.max_batch_delay_ms,
         host=args.bind_address,
         port=args.port,
+        audit_log=args.audit_log or None,
+        audit_relevant_only=not args.audit_all,
     )
 
 

@@ -189,7 +189,8 @@ class EngineReconciler:
             f"--failure-policy={engine.spec.failure_policy}",
             f"--max-batch-size={tpu.max_batch_size}",
             f"--max-batch-delay-ms={tpu.max_batch_delay_ms}",
-        ]
+            "--audit-log=-",  # SecAuditLog /dev/stdout parity; pod logs
+        ]  # carry the audit stream the conformance runner matches against
         return Unstructured(
             kind="Deployment",
             api_version="apps/v1",

@@ -60,6 +60,16 @@ class WafEngine:
         self._rule_ids = np.asarray(
             [r.rule_id for r in self.compiled.rules] or [0], dtype=np.int64
         )
+        # Rule metadata for the audit log (id/msg/severity/tags).
+        self.rule_meta: dict[int, dict] = {
+            r.rule_id: {
+                "id": r.rule_id,
+                "msg": r.msg,
+                "severity": r.severity,
+                "tags": list(r.tags),
+            }
+            for r in self.compiled.rules
+        }
         self._host_pipelines = self.compiled.host_pipelines()
         # Kinds visible to each host pipeline — rows outside the set skip the
         # (sequential, Python) transform on the hot path.

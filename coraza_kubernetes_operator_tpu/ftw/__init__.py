@@ -1,0 +1,26 @@
+"""go-ftw-compatible conformance tier.
+
+The reference's tier-4 test strategy (SURVEY §3.5, §4) replays the OWASP
+CRS regression corpus through a live gateway with ``go-ftw``, matching
+expected HTTP status and WAF audit-log content, with a known-failure
+ledger in ``ftw/ftw.yml`` (reference ``ftw/run.py:339-362``,
+``ftw/ftw.yml``). This package is the first-party equivalent: a loader
+for go-ftw's YAML test format (both the legacy ``test_title``/``stage``
+nesting and the newer ``rule_id``/``test_id`` + ``log.expect_ids``
+shape), a replayer that drives either an in-process ``WafEngine`` or a
+live tpu-engine sidecar over HTTP, and the same ignore-ledger semantics.
+"""
+
+from .loader import FtwStage, FtwTest, load_overrides, load_test_file, load_tests
+from .runner import FtwResult, FtwRunner, run_corpus
+
+__all__ = [
+    "FtwStage",
+    "FtwTest",
+    "FtwResult",
+    "FtwRunner",
+    "load_overrides",
+    "load_test_file",
+    "load_tests",
+    "run_corpus",
+]

@@ -1,0 +1,153 @@
+"""Loader for go-ftw YAML test files and override ledgers.
+
+Supports both corpus generations found in OWASP CRS regression tests:
+
+- legacy: ``tests: [{test_title: "942100-1", stages: [{stage: {input:
+  {...}, output: {...}}}]}]``
+- current: ``rule_id: 942100`` + ``tests: [{test_id: 1, stages:
+  [{input: {...}, output: {status: 403, log: {expect_ids: [...]}}}]}]``
+
+Output assertions normalized to: expected status list, expect/no-expect
+rule ids, and log_contains / no_log_contains regexes matched against raw
+audit-log lines.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+
+@dataclass
+class FtwStage:
+    method: str = "GET"
+    uri: str = "/"
+    version: str = "HTTP/1.1"
+    headers: list[tuple[str, str]] = field(default_factory=list)
+    data: bytes = b""
+    # assertions
+    status: list[int] = field(default_factory=list)
+    expect_ids: list[int] = field(default_factory=list)
+    no_expect_ids: list[int] = field(default_factory=list)
+    log_contains: str | None = None
+    no_log_contains: str | None = None
+
+
+@dataclass
+class FtwTest:
+    title: str  # e.g. "942100-1"
+    rule_id: int | None
+    description: str = ""
+    stages: list[FtwStage] = field(default_factory=list)
+    source: str = ""  # file it came from
+
+
+class FtwFormatError(ValueError):
+    pass
+
+
+def _as_bytes(data) -> bytes:
+    if data is None:
+        return b""
+    if isinstance(data, bytes):
+        return data
+    if isinstance(data, str):
+        return data.encode("utf-8", "replace")
+    if isinstance(data, list):  # go-ftw joins list lines with \r\n
+        return "\r\n".join(str(x) for x in data).encode("utf-8", "replace")
+    return str(data).encode()
+
+
+def _parse_stage(obj: dict, source: str) -> FtwStage:
+    if "stage" in obj:  # legacy nesting
+        obj = obj["stage"]
+    inp = obj.get("input", {}) or {}
+    out = obj.get("output", {}) or {}
+
+    headers = inp.get("headers", {}) or {}
+    if isinstance(headers, dict):
+        header_list = [(str(k), str(v)) for k, v in headers.items()]
+    else:
+        header_list = [(str(k), str(v)) for k, v in headers]
+
+    status = out.get("status", [])
+    if status is None:
+        status = []
+    if isinstance(status, int):
+        status = [status]
+    status = [int(s) for s in status]
+
+    log = out.get("log", {}) or {}
+    expect_ids = [int(x) for x in (log.get("expect_ids") or [])]
+    no_expect_ids = [int(x) for x in (log.get("no_expect_ids") or [])]
+
+    return FtwStage(
+        method=str(inp.get("method", "GET")),
+        uri=str(inp.get("uri", "/")),
+        version=str(inp.get("version", "HTTP/1.1")),
+        headers=header_list,
+        data=_as_bytes(inp.get("data")),
+        status=status,
+        expect_ids=expect_ids,
+        no_expect_ids=no_expect_ids,
+        log_contains=out.get("log_contains") or log.get("match_regex"),
+        no_log_contains=out.get("no_log_contains") or log.get("no_match_regex"),
+    )
+
+
+def load_test_file(path: str | Path) -> list[FtwTest]:
+    path = Path(path)
+    doc = yaml.safe_load(path.read_text())
+    if not isinstance(doc, dict) or "tests" not in doc:
+        raise FtwFormatError(f"{path}: not a go-ftw test file (no 'tests' key)")
+    file_rule_id = doc.get("rule_id")
+    meta = doc.get("meta", {}) or {}
+    tests: list[FtwTest] = []
+    for t in doc["tests"] or []:
+        title = t.get("test_title")
+        if title is None:
+            if file_rule_id is None or t.get("test_id") is None:
+                raise FtwFormatError(
+                    f"{path}: test needs test_title or rule_id+test_id"
+                )
+            title = f"{file_rule_id}-{t['test_id']}"
+        rule_id = file_rule_id
+        if rule_id is None:
+            head = str(title).split("-", 1)[0]
+            rule_id = int(head) if head.isdigit() else None
+        stages = [_parse_stage(s, str(path)) for s in t.get("stages", [])]
+        tests.append(
+            FtwTest(
+                title=str(title),
+                rule_id=rule_id,
+                description=t.get("desc", meta.get("description", "")) or "",
+                stages=stages,
+                source=str(path),
+            )
+        )
+    return tests
+
+
+def load_tests(root: str | Path) -> list[FtwTest]:
+    """Recursively load every ``*.yaml`` test file under ``root``."""
+    root = Path(root)
+    tests: list[FtwTest] = []
+    for path in sorted(root.rglob("*.yaml")):
+        if path.name == "ftw.yml":
+            continue
+        tests.extend(load_test_file(path))
+    for path in sorted(root.rglob("*.yml")):
+        if path.name == "ftw.yml":
+            continue
+        tests.extend(load_test_file(path))
+    return tests
+
+
+def load_overrides(path: str | Path) -> dict[str, str]:
+    """The known-failure ledger: {test title: reason} (go-ftw
+    ``testoverride.ignore`` shape, reference ``ftw/ftw.yml``)."""
+    doc = yaml.safe_load(Path(path).read_text()) or {}
+    ignore = (doc.get("testoverride") or {}).get("ignore") or {}
+    return {str(k): str(v) for k, v in ignore.items()}

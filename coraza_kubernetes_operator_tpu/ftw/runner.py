@@ -1,0 +1,221 @@
+"""Conformance replayer: drive loaded ftw tests against the WAF.
+
+Two targets, mirroring how the reference runs go-ftw against a live
+gateway while unit tiers run in-process (SURVEY §4):
+
+- **in-process**: each stage is evaluated directly on a ``WafEngine``;
+  the audit line is synthesized with the same ``AuditLogger`` the sidecar
+  uses, so log assertions exercise the real serialization.
+- **HTTP**: each stage is sent to a live tpu-engine sidecar (filter
+  mode); audit lines are read from the sidecar's audit-log file — the
+  shape of the reference's ftw/run.py pod-log streaming.
+
+Pass criteria per stage: expected status (if asserted) AND expected /
+forbidden rule ids in the audit line AND log_contains / no_log_contains
+regexes. A test passes when every stage passes. Tests in the override
+ledger are reported ``ignored`` and never fail the run.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..engine.request import HttpRequest
+from ..engine.waf import WafEngine
+from ..observability.audit import AuditLogger, AuditRecord
+from ..utils import get_logger
+from .loader import FtwStage, FtwTest, load_overrides, load_tests
+
+log = get_logger("ftw.runner")
+
+
+@dataclass
+class StageOutcome:
+    passed: bool
+    reason: str = ""
+
+
+@dataclass
+class FtwResult:
+    passed: list[str] = field(default_factory=list)
+    failed: dict[str, str] = field(default_factory=dict)  # title -> reason
+    ignored: dict[str, str] = field(default_factory=dict)  # title -> ledger reason
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed
+
+    def summary(self) -> dict:
+        return {
+            "passed": len(self.passed),
+            "failed": len(self.failed),
+            "ignored": len(self.ignored),
+            "failures": self.failed,
+        }
+
+
+def _stage_request(stage: FtwStage) -> HttpRequest:
+    return HttpRequest(
+        method=stage.method,
+        uri=stage.uri,
+        version=stage.version,
+        headers=stage.headers,
+        body=stage.data,
+        remote_addr="127.0.0.1",
+    )
+
+
+def _ids_in_line(line: str) -> set[int]:
+    ids: set[int] = set()
+    try:
+        doc = json.loads(line)
+        for m in doc.get("transaction", {}).get("messages", []):
+            rid = m.get("details", {}).get("ruleId")
+            if rid and str(rid).isdigit():
+                ids.add(int(rid))
+    except (ValueError, AttributeError):
+        pass
+    # fallback: grep id "NNN" escaped or raw
+    for m in re.finditer(r'id \\?"(\d+)\\?"', line):
+        ids.add(int(m.group(1)))
+    return ids
+
+
+def check_stage(stage: FtwStage, status: int, audit_lines: list[str]) -> StageOutcome:
+    if stage.status and status not in stage.status:
+        return StageOutcome(False, f"status {status} not in {stage.status}")
+    joined = "\n".join(audit_lines)
+    seen: set[int] = set()
+    for line in audit_lines:
+        seen |= _ids_in_line(line)
+    for rid in stage.expect_ids:
+        if rid not in seen:
+            return StageOutcome(False, f"rule {rid} not in audit log (saw {sorted(seen)})")
+    for rid in stage.no_expect_ids:
+        if rid in seen:
+            return StageOutcome(False, f"forbidden rule {rid} in audit log")
+    if stage.log_contains and not re.search(stage.log_contains, joined):
+        return StageOutcome(False, f"log_contains {stage.log_contains!r} not found")
+    if stage.no_log_contains and re.search(stage.no_log_contains, joined):
+        return StageOutcome(False, f"no_log_contains {stage.no_log_contains!r} found")
+    return StageOutcome(True)
+
+
+class FtwRunner:
+    """Replays tests against an in-process engine or a live sidecar."""
+
+    def __init__(
+        self,
+        engine: WafEngine | None = None,
+        base_url: str | None = None,
+        audit_log_path: str | None = None,
+        overrides: dict[str, str] | None = None,
+    ):
+        if (engine is None) == (base_url is None):
+            raise ValueError("exactly one of engine / base_url required")
+        self.engine = engine
+        self.base_url = base_url.rstrip("/") if base_url else None
+        self.audit_log_path = audit_log_path
+        self.overrides = overrides or {}
+
+    # -- stage execution ----------------------------------------------------
+
+    def _run_stage_inproc(self, stage: FtwStage) -> tuple[int, list[str]]:
+        assert self.engine is not None
+        req = _stage_request(stage)
+        verdict = self.engine.evaluate_one(req)
+        buf = io.StringIO()
+        logger = AuditLogger(stream=buf, relevant_only=False)
+        meta = self.engine.rule_meta
+        logger.log(
+            AuditRecord(
+                request_line=f"{req.method} {req.uri} {req.version}",
+                client=req.remote_addr,
+                status=verdict.status,
+                interrupted=verdict.interrupted,
+                matched=[meta.get(r, {"id": r}) for r in verdict.matched_ids],
+            )
+        )
+        status = verdict.status if verdict.interrupted else 200
+        return status, buf.getvalue().splitlines()
+
+    def _run_stage_http(self, stage: FtwStage) -> tuple[int, list[str]]:
+        assert self.base_url is not None
+        mark = 0
+        audit = Path(self.audit_log_path) if self.audit_log_path else None
+        if audit is not None and audit.exists():
+            mark = audit.stat().st_size
+        req = urllib.request.Request(
+            self.base_url + stage.uri,
+            method=stage.method,
+            data=stage.data or None,
+            headers=dict(stage.headers),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                status = resp.status
+                resp.read()
+        except urllib.error.HTTPError as err:
+            status = err.code
+            err.read()
+        except urllib.error.URLError as err:
+            # Connection refused/reset (sidecar restarting): fail this test
+            # with a status the assertions can report, don't abort the run.
+            log.error("stage request failed", err, uri=stage.uri)
+            return 0, []
+        lines: list[str] = []
+        if audit is not None:
+            # the sidecar flushes per line; small settle loop for batching
+            for _ in range(50):
+                if audit.exists() and audit.stat().st_size > mark:
+                    break
+                time.sleep(0.01)
+            if audit.exists():
+                with open(audit, encoding="utf-8") as fh:
+                    fh.seek(mark)
+                    lines = fh.read().splitlines()
+        return status, lines
+
+    # -- test execution -----------------------------------------------------
+
+    def run(self, tests: list[FtwTest]) -> FtwResult:
+        result = FtwResult()
+        for test in tests:
+            if test.title in self.overrides:
+                result.ignored[test.title] = self.overrides[test.title]
+                continue
+            failure = None
+            for i, stage in enumerate(test.stages):
+                if self.engine is not None:
+                    status, lines = self._run_stage_inproc(stage)
+                else:
+                    status, lines = self._run_stage_http(stage)
+                outcome = check_stage(stage, status, lines)
+                if not outcome.passed:
+                    failure = f"stage {i}: {outcome.reason}"
+                    break
+            if failure is None:
+                result.passed.append(test.title)
+            else:
+                result.failed[test.title] = failure
+                log.info("ftw test failed", test=test.title, reason=failure)
+        return result
+
+
+def run_corpus(
+    corpus_dir: str | Path,
+    rules: str,
+    overrides_path: str | Path | None = None,
+) -> FtwResult:
+    """Convenience: compile ``rules``, load every test under ``corpus_dir``
+    and replay in-process honoring the ledger."""
+    overrides = load_overrides(overrides_path) if overrides_path else {}
+    runner = FtwRunner(engine=WafEngine(rules), overrides=overrides)
+    return runner.run(load_tests(corpus_dir))

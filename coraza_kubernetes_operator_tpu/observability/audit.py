@@ -1,0 +1,110 @@
+"""WAF audit log: JSON lines in a ModSecurity-compatible shape.
+
+The reference's data plane writes ``SecAuditLog /dev/stdout`` with
+``SecAuditLogFormat JSON`` (reference
+``hack/generate_coreruleset_configmaps.py:47-49``) and the ftw runner
+streams those lines to a file that go-ftw greps with patterns like
+``id "942100"`` (reference ``ftw/run.py:118-141,258-287``). This logger
+emits the same essentials per transaction: unique id, client/host info,
+request line, and one ``messages[]`` entry per matched rule whose
+``details.ruleId`` / ``message`` render as ``[id "942100"] [msg "..."]``
+inside the line, so both JSON consumers and regex log matchers work.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import IO
+
+
+@dataclass
+class AuditRecord:
+    """One evaluated transaction."""
+
+    request_line: str
+    client: str = ""
+    host: str = ""
+    status: int = 200
+    interrupted: bool = False
+    matched: list[dict] = field(default_factory=list)  # rule metadata dicts
+    tenant: str = ""
+
+
+class AuditLogger:
+    """Serializes audit records as JSON lines to a stream or file.
+
+    ``relevant_only`` mirrors ``SecAuditEngine RelevantOnly``: only
+    transactions that matched at least one rule (or were interrupted) are
+    written.
+    """
+
+    def __init__(
+        self,
+        stream: IO[str] | None = None,
+        path: str | None = None,
+        relevant_only: bool = True,
+    ):
+        if stream is None and path is None:
+            raise ValueError("AuditLogger needs a stream or a path")
+        self._own = stream is None
+        self._stream: IO[str] = stream or open(path, "a", encoding="utf-8")  # noqa: SIM115
+        self.relevant_only = relevant_only
+        self._lock = threading.Lock()
+        self.written = 0
+
+    def log(self, record: AuditRecord) -> None:
+        if self.relevant_only and not record.matched and not record.interrupted:
+            return
+        messages = []
+        for rule in record.matched:
+            rid = rule.get("id", 0)
+            msg = rule.get("msg") or ""
+            severity = rule.get("severity") or ""
+            tags = rule.get("tags") or []
+            # The rendered "data" string is what regex-based log matchers
+            # (go-ftw log_contains: id "NNN") search for.
+            data = f'[id "{rid}"]'
+            if msg:
+                data += f' [msg "{msg}"]'
+            if severity:
+                data += f' [severity "{severity}"]'
+            for t in tags:
+                data += f' [tag "{t}"]'
+            messages.append(
+                {
+                    "message": msg,
+                    "details": {
+                        "ruleId": str(rid),
+                        "severity": severity,
+                        "tags": tags,
+                        "match": data,
+                    },
+                }
+            )
+        doc = {
+            "transaction": {
+                "id": uuid.uuid4().hex[:16],
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                "client_ip": record.client,
+                "host_ip": record.host,
+                "tenant": record.tenant,
+                "request": {"line": record.request_line},
+                "response": {"status": record.status},
+                "interrupted": record.interrupted,
+                "messages": messages,
+            }
+        }
+        line = json.dumps(doc, separators=(",", ":"))
+        with self._lock:
+            self._stream.write(line + "\n")
+            self._stream.flush()
+            self.written += 1
+
+    def close(self) -> None:
+        if self._own:
+            with self._lock:
+                self._stream.close()

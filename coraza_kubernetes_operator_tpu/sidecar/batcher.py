@@ -38,6 +38,7 @@ class BatcherStats:
     errors: int = 0
     batch_sizes: list[int] = field(default_factory=list)
     step_latencies_s: list[float] = field(default_factory=list)
+    on_batch: object = None  # optional (size, latency_s) hook for metrics
     _max_samples: int = 4096
 
     def record(self, size: int, latency_s: float) -> None:
@@ -48,6 +49,8 @@ class BatcherStats:
             del self.step_latencies_s[: self._max_samples // 2]
         self.batch_sizes.append(size)
         self.step_latencies_s.append(latency_s)
+        if self.on_batch is not None:
+            self.on_batch(size, latency_s)  # type: ignore[operator]
 
     def snapshot(self) -> dict:
         lats = sorted(self.step_latencies_s)

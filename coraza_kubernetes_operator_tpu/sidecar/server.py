@@ -30,8 +30,12 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import sys
+
 from ..engine.request import HttpRequest
 from ..engine.waf import Verdict, WafEngine
+from ..observability import AuditLogger, MetricsRegistry
+from ..observability.audit import AuditRecord
 from ..utils import get_logger
 from .batcher import (
     DEFAULT_MAX_BATCH_DELAY_MS,
@@ -62,6 +66,10 @@ class SidecarConfig:
     host: str = "0.0.0.0"
     port: int = 9090
     request_timeout_s: float = 30.0
+    # Audit log: None disables, "-" is stdout (the reference data plane's
+    # SecAuditLog /dev/stdout shape), anything else a file path.
+    audit_log: str | None = None
+    audit_relevant_only: bool = True
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -149,6 +157,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_healthz()
         elif path == API_PREFIX + "stats":
             self._reply_json(200, self.sidecar.stats())
+        elif path == API_PREFIX + "metrics":
+            self._reply(
+                200,
+                self.sidecar.metrics.render().encode(),
+                {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+            )
         elif path.startswith(API_PREFIX):
             self._reply_json(404, {"error": "not found"})
         else:
@@ -192,6 +206,7 @@ class _Handler(BaseHTTPRequestHandler):
             log.error("filter evaluation failed", err)
             self._unavailable()
             return
+        self.sidecar.record_verdict(req, verdict)
         if verdict.interrupted:
             self._reply(
                 verdict.status,
@@ -225,6 +240,8 @@ class _Handler(BaseHTTPRequestHandler):
             log.error("bulk evaluation failed", err)  # dropped connection
             self._reply_json(500, {"error": f"evaluation failed: {err}"})
             return
+        for r, v in zip(reqs, verdicts):
+            self.sidecar.record_verdict(r, v)
         self._reply_json(200, {"verdicts": [verdict_to_json(v) for v in verdicts]})
 
     def _unavailable(self) -> None:
@@ -265,9 +282,68 @@ class TpuEngineSidecar:
             max_batch_size=config.max_batch_size,
             max_batch_delay_ms=config.max_batch_delay_ms,
         )
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "waf_requests_total", "Evaluated requests by action", ("action",)
+        )
+        self._m_batches = self.metrics.counter(
+            "waf_batches_total", "Device evaluation batches"
+        )
+        self._m_batch_size = self.metrics.histogram(
+            "waf_batch_size", "Requests per device batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+        )
+        self._m_step = self.metrics.histogram(
+            "waf_batch_step_seconds", "Device batch step latency"
+        )
+        self._m_ready = self.metrics.gauge(
+            "waf_ready", "1 when a compiled ruleset is loaded"
+        )
+        self._m_ready.set_function(lambda: 1.0 if self.ready() else 0.0)
+        self.metrics.gauge(
+            "waf_ruleset_reloads", "Successful hot reloads"
+        ).set_function(lambda: float(self.reloader.reloads))
+        self.metrics.gauge(
+            "waf_ruleset_reload_failures", "Failed hot reloads"
+        ).set_function(lambda: float(self.reloader.failed_reloads))
+        self.batcher.stats.on_batch = self._on_batch
+        self.audit: AuditLogger | None = None
+        if config.audit_log == "-":
+            self.audit = AuditLogger(
+                stream=sys.stdout, relevant_only=config.audit_relevant_only
+            )
+        elif config.audit_log:
+            self.audit = AuditLogger(
+                path=config.audit_log, relevant_only=config.audit_relevant_only
+            )
         self._httpd = _Server((config.host, config.port), _Handler)
         self._httpd.sidecar = self  # type: ignore[attr-defined]
         self._serve_thread: threading.Thread | None = None
+
+    def _on_batch(self, size: int, latency_s: float) -> None:
+        self._m_batches.inc()
+        self._m_batch_size.observe(size)
+        self._m_step.observe(latency_s)
+
+    def record_verdict(self, request: HttpRequest, verdict: Verdict) -> None:
+        """Per-request accounting: metrics counter + audit log line."""
+        self._m_requests.inc(action="deny" if verdict.interrupted else "allow")
+        if self.audit is None:
+            return
+        engine = self.reloader.engine
+        meta = engine.rule_meta if engine is not None else {}
+        self.audit.log(
+            AuditRecord(
+                request_line=f"{request.method} {request.uri} {request.version}",
+                client=request.remote_addr,
+                status=verdict.status,
+                interrupted=verdict.interrupted,
+                matched=[
+                    meta.get(rid, {"id": rid}) for rid in verdict.matched_ids
+                ],
+                tenant=self.config.instance_key,
+            )
+        )
 
     @property
     def port(self) -> int:
@@ -325,4 +401,6 @@ class TpuEngineSidecar:
         self._httpd.server_close()
         self.batcher.stop()
         self.reloader.stop()
+        if self.audit is not None:
+            self.audit.close()
         log.info("tpu-engine sidecar stopped")

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Conformance runner CLI — the analog of the reference's ftw/run.py.
+
+Where the reference resolves a Gateway Service, port-forwards, streams pod
+logs and execs go-ftw (reference ``ftw/run.py:207-362``), this runner
+replays the same YAML corpus either in-process (compile rules, evaluate
+directly — the fast CI tier) or against a live tpu-engine sidecar over
+HTTP with audit-log matching (the integration tier).
+
+Usage:
+  python ftw/run.py                                   # bundled corpus, in-proc
+  python ftw/run.py --corpus DIR --rules a.conf b.conf
+  python ftw/run.py --mode http --url http://127.0.0.1:9090 \
+      --audit-log /var/log/waf-audit.log
+
+Exit code 0 iff no non-ignored test failed. Prints one JSON summary line.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--corpus", default=str(HERE / "tests"))
+    ap.add_argument(
+        "--rules",
+        nargs="*",
+        default=[str(HERE / "rules" / "base.conf"), str(HERE / "rules" / "crs-mini.conf")],
+        help="Seclang files compiled in order (in-proc mode)",
+    )
+    ap.add_argument("--overrides", default=str(HERE / "ftw.yml"))
+    ap.add_argument("--mode", choices=("inproc", "http"), default="inproc")
+    ap.add_argument("--url", default="http://127.0.0.1:9090")
+    ap.add_argument("--audit-log", default=None, help="sidecar audit log path (http mode)")
+    args = ap.parse_args()
+
+    from coraza_kubernetes_operator_tpu.ftw import (
+        FtwRunner,
+        load_overrides,
+        load_tests,
+    )
+
+    overrides = load_overrides(args.overrides) if Path(args.overrides).exists() else {}
+    tests = load_tests(args.corpus)
+    if args.mode == "inproc":
+        from coraza_kubernetes_operator_tpu.engine import WafEngine
+
+        rules = "\n".join(Path(p).read_text() for p in args.rules)
+        runner = FtwRunner(engine=WafEngine(rules), overrides=overrides)
+    else:
+        runner = FtwRunner(
+            base_url=args.url, audit_log_path=args.audit_log, overrides=overrides
+        )
+
+    result = runner.run(tests)
+    print(json.dumps({"mode": args.mode, "tests": len(tests), **result.summary()}))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

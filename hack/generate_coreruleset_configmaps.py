@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+"""Split an OWASP Core Rule Set checkout into rule-source manifests.
+
+Capability parity with the reference's CRS tooling (reference
+``hack/generate_coreruleset_configmaps.py``): every CRS ``.conf`` file
+becomes one ConfigMap carrying its Seclang under the ``rules`` key, plus a
+RuleSet manifest referencing all ConfigMaps in load order. Differences
+worth knowing:
+
+- rules using ``@pmFromFile`` are dropped (file data files are not shipped
+  into ConfigMaps); ``--keep-pmFromFile`` keeps them for engines that
+  resolve data files some other way;
+- ``--ignore-rules`` drops specific rule ids (for known-incompatible
+  rules);
+- ``--include-test-rule`` appends the ftw marker rule that echoes the
+  ``X-CRS-Test`` header into the audit log, which go-ftw uses to delimit
+  test boundaries;
+- the embedded base config is RE2-subset only (no negative lookahead),
+  matching the constraint the TPU regex engine shares with the WASM data
+  plane.
+
+Usage:
+  python hack/generate_coreruleset_configmaps.py \
+      --crs-dir build/coreruleset --out-dir build/crs-manifests \
+      --include-test-rule --ignore-pmFromFile [--validate] [--apply]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+BASE_CONF = """\
+# Engine base configuration (generated). RE2-subset regexes only.
+SecRuleEngine On
+SecRequestBodyAccess On
+SecRequestBodyLimit 131072
+SecRequestBodyInMemoryLimit 131072
+SecDefaultAction "phase:1,log,pass"
+SecDefaultAction "phase:2,log,pass"
+SecAuditEngine RelevantOnly
+SecAuditLog /dev/stdout
+SecAuditLogFormat JSON
+"""
+
+TEST_RULE = """\
+# ftw marker rule: logs the X-CRS-Test header value so the conformance
+# runner can delimit per-test log sections.
+SecRule REQUEST_HEADERS:X-CRS-Test "@rx ^.*$" \\
+  "id:999999,phase:1,pass,t:none,log,msg:'X-CRS-Test %{MATCHED_VAR}'"
+"""
+
+_RULE_START = re.compile(r"^\s*Sec(Rule|Action)\b", re.IGNORECASE)
+_ID_RE = re.compile(r"\bid\s*:\s*'?(\d+)", re.IGNORECASE)
+
+
+def split_directives(text: str) -> list[str]:
+    """Split a .conf into directive blocks (continuation-line aware),
+    keeping comments attached to the following directive."""
+    blocks: list[str] = []
+    cur: list[str] = []
+    for raw in text.splitlines():
+        cur.append(raw)
+        stripped = raw.rstrip()
+        if stripped.endswith("\\"):
+            continue
+        blocks.append("\n".join(cur))
+        cur = []
+    if cur:
+        blocks.append("\n".join(cur))
+    return blocks
+
+
+def directive_rule_id(block: str) -> int | None:
+    if not _RULE_START.search(block):
+        return None
+    m = _ID_RE.search(block)
+    return int(m.group(1)) if m else None
+
+
+def filter_conf(
+    text: str, ignore_ids: set[int], drop_pm_from_file: bool
+) -> tuple[str, list[int]]:
+    """Drop ignored/unsupported directives; returns (text, dropped ids)."""
+    out: list[str] = []
+    dropped: list[int] = []
+    for block in split_directives(text):
+        rid = directive_rule_id(block)
+        if rid is not None and rid in ignore_ids:
+            dropped.append(rid)
+            continue
+        if drop_pm_from_file and re.search(r"@pmFromFile\b", block, re.IGNORECASE):
+            if rid is not None:
+                dropped.append(rid)
+            continue
+        out.append(block)
+    return "\n".join(out) + "\n", dropped
+
+
+def configmap_name(conf_path: Path) -> str:
+    stem = conf_path.stem.lower()
+    stem = re.sub(r"[^a-z0-9.-]+", "-", stem).strip("-.")
+    return f"crs-{stem}"[:253]
+
+
+def yaml_block_literal(text: str, indent: int) -> str:
+    pad = " " * indent
+    return "\n".join(pad + line if line else "" for line in text.splitlines())
+
+
+def render_configmap(name: str, namespace: str, rules: str) -> str:
+    return (
+        "apiVersion: v1\n"
+        "kind: ConfigMap\n"
+        "metadata:\n"
+        f"  name: {name}\n"
+        f"  namespace: {namespace}\n"
+        "data:\n"
+        "  rules: |\n" + yaml_block_literal(rules, 4) + "\n"
+    )
+
+
+def render_ruleset(name: str, namespace: str, sources: list[str]) -> str:
+    refs = "".join(f"    - name: {s}\n" for s in sources)
+    return (
+        "apiVersion: waf.k8s.coraza.io/v1alpha1\n"
+        "kind: RuleSet\n"
+        "metadata:\n"
+        f"  name: {name}\n"
+        f"  namespace: {namespace}\n"
+        "spec:\n"
+        "  rules:\n" + refs
+    )
+
+
+def collect_conf_files(crs_dir: Path) -> list[Path]:
+    """CRS load order: setup first, then rules/*.conf sorted (CRS encodes
+    ordering in the numeric filename prefixes)."""
+    files: list[Path] = []
+    for candidate in ("crs-setup.conf.example", "crs-setup.conf"):
+        p = crs_dir / candidate
+        if p.exists():
+            files.append(p)
+            break
+    rules_dir = crs_dir / "rules"
+    if rules_dir.is_dir():
+        files.extend(sorted(rules_dir.glob("*.conf")))
+    if not files:
+        raise SystemExit(f"no .conf files found under {crs_dir}")
+    return files
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--crs-dir", required=True, type=Path)
+    ap.add_argument("--out-dir", required=True, type=Path)
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--ruleset-name", default="coreruleset")
+    ap.add_argument("--ignore-rules", default="", help="comma-separated rule ids to drop")
+    ap.add_argument("--ignore-pmFromFile", action="store_true", dest="ignore_pmff")
+    ap.add_argument("--keep-pmFromFile", action="store_false", dest="ignore_pmff")
+    ap.add_argument("--include-test-rule", action="store_true")
+    ap.add_argument("--validate", action="store_true",
+                    help="compile the aggregate with the TPU engine compiler")
+    ap.add_argument("--apply", action="store_true", help="kubectl apply --server-side")
+    args = ap.parse_args()
+
+    ignore_ids = {int(x) for x in args.ignore_rules.split(",") if x.strip()}
+    args.out_dir.mkdir(parents=True, exist_ok=True)
+
+    sources: list[str] = []
+    aggregate: list[str] = []
+    manifests: list[Path] = []
+
+    base = BASE_CONF + (TEST_RULE if args.include_test_rule else "")
+    base_name = "crs-base-config"
+    path = args.out_dir / f"00-{base_name}.yaml"
+    path.write_text(render_configmap(base_name, args.namespace, base))
+    manifests.append(path)
+    sources.append(base_name)
+    aggregate.append(base)
+
+    total_dropped: list[int] = []
+    for i, conf in enumerate(collect_conf_files(args.crs_dir), start=1):
+        text, dropped = filter_conf(
+            conf.read_text(encoding="utf-8", errors="replace"),
+            ignore_ids,
+            args.ignore_pmff,
+        )
+        total_dropped.extend(dropped)
+        name = configmap_name(conf)
+        path = args.out_dir / f"{i:02d}-{name}.yaml"
+        path.write_text(render_configmap(name, args.namespace, text))
+        manifests.append(path)
+        sources.append(name)
+        aggregate.append(text)
+
+    ruleset_path = args.out_dir / "99-ruleset.yaml"
+    ruleset_path.write_text(
+        render_ruleset(args.ruleset_name, args.namespace, sources)
+    )
+    manifests.append(ruleset_path)
+
+    print(
+        f"wrote {len(manifests)} manifests to {args.out_dir} "
+        f"({len(sources)} rule sources, {len(total_dropped)} directives dropped)"
+    )
+
+    if args.validate:
+        sys.path.insert(0, str(REPO))
+        from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+
+        compiled = compile_rules("\n".join(aggregate))
+        print(
+            f"validated: {compiled.n_rules} rules, {compiled.n_groups} match groups, "
+            f"{len(compiled.report.skipped)} skipped"
+        )
+
+    if args.apply:
+        for m in manifests:
+            subprocess.run(
+                ["kubectl", "apply", "--server-side", "-f", str(m)], check=True
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Local cluster bootstrap for integration/conformance runs.
+
+Role parity with the reference's ``hack/kind_cluster.py`` (kind + Gateway
+API CRDs + Istio via Sail + MetalLB + operator): creates a kind cluster,
+installs the Gateway API CRDs, optionally installs Istio (via istioctl if
+present), and deploys this operator with kustomize. Written for clarity
+over completeness — flags gate each layer so CI can install only what a
+job needs.
+
+Usage:
+  python hack/kind_cluster.py setup [--name coraza-tpu] [--istio]
+  python hack/kind_cluster.py delete [--name coraza-tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+GATEWAY_API_VERSION = "v1.4.1"
+GATEWAY_API_URL = (
+    "https://github.com/kubernetes-sigs/gateway-api/releases/download/"
+    "{v}/standard-install.yaml"
+)
+
+
+def run(*cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.run(list(cmd), check=check)
+
+
+def need(binary: str) -> None:
+    if shutil.which(binary) is None:
+        raise SystemExit(f"required binary not found on PATH: {binary}")
+
+
+def cluster_exists(name: str) -> bool:
+    out = subprocess.run(
+        ["kind", "get", "clusters"], capture_output=True, text=True
+    )
+    return name in out.stdout.split()
+
+
+def cmd_setup(args: argparse.Namespace) -> int:
+    need("kind")
+    need("kubectl")
+    if not cluster_exists(args.name):
+        run("kind", "create", "cluster", "--name", args.name)
+    else:
+        print(f"kind cluster {args.name} already exists")
+
+    # Gateway API CRDs (pinned, reference installs v1.4.1).
+    run(
+        "kubectl", "apply", "--server-side", "-f",
+        GATEWAY_API_URL.format(v=args.gateway_api_version),
+    )
+
+    if args.istio:
+        need("istioctl")
+        run(
+            "istioctl", "install", "-y",
+            "--set", "profile=minimal",
+            "--set", "values.pilot.env.PILOT_ENABLE_ALPHA_GATEWAY_API=true",
+        )
+        gatewayclass = (
+            "apiVersion: gateway.networking.k8s.io/v1\n"
+            "kind: GatewayClass\n"
+            "metadata:\n  name: istio\nspec:\n  controllerName: istio.io/gateway-controller\n"
+        )
+        p = subprocess.run(
+            ["kubectl", "apply", "-f", "-"], input=gatewayclass, text=True
+        )
+        if p.returncode:
+            return p.returncode
+
+    # Operator: CRDs + RBAC + manager.
+    run("kubectl", "apply", "--server-side", "-k", str(REPO / "config" / "default"))
+    run(
+        "kubectl", "-n", "coraza-tpu-system", "rollout", "restart",
+        "deployment/coraza-tpu-controller-manager", check=False,
+    )
+    print("cluster ready")
+    return 0
+
+
+def cmd_delete(args: argparse.Namespace) -> int:
+    need("kind")
+    if cluster_exists(args.name):
+        run("kind", "delete", "cluster", "--name", args.name)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("setup", cmd_setup), ("delete", cmd_delete)):
+        p = sub.add_parser(name)
+        p.add_argument("--name", default="coraza-tpu")
+        p.add_argument("--gateway-api-version", default=GATEWAY_API_VERSION)
+        p.add_argument("--istio", action="store_true")
+        p.set_defaults(fn=fn)
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

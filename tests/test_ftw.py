@@ -1,0 +1,146 @@
+"""Conformance tier tests: loader formats, stage checking, corpus replay.
+
+Reference analog: tier 4 of the test strategy — go-ftw over the CRS corpus
+against a live gateway, with the ftw.yml ignore ledger (SURVEY §3.5, §4).
+Here the bundled corpus replays both in-process and against a live sidecar
+with audit-log matching.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import WafEngine
+from coraza_kubernetes_operator_tpu.ftw import (
+    FtwRunner,
+    load_overrides,
+    load_test_file,
+    load_tests,
+)
+from coraza_kubernetes_operator_tpu.ftw.loader import FtwFormatError
+from coraza_kubernetes_operator_tpu.ftw.runner import check_stage
+from coraza_kubernetes_operator_tpu.ftw.loader import FtwStage
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "ftw" / "tests"
+LEDGER = REPO / "ftw" / "ftw.yml"
+
+
+def _rules() -> str:
+    return (REPO / "ftw" / "rules" / "base.conf").read_text() + (
+        REPO / "ftw" / "rules" / "crs-mini.conf"
+    ).read_text()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WafEngine(_rules())
+
+
+# -- loader -------------------------------------------------------------------
+
+
+def test_load_new_format():
+    tests = load_test_file(CORPUS / "942100.yaml")
+    assert [t.title for t in tests] == ["942100-1", "942100-2", "942100-3", "942100-4"]
+    assert tests[0].rule_id == 942100
+    st = tests[0].stages[0]
+    assert st.method == "GET" and st.status == [403]
+    assert st.expect_ids == [942100]
+    body = tests[3].stages[0]
+    assert body.method == "POST" and b"union select" in body.data
+
+
+def test_load_legacy_format():
+    tests = load_test_file(CORPUS / "941100.yaml")
+    assert tests[0].title == "941100-1"
+    assert tests[0].rule_id == 941100  # derived from title
+    assert tests[0].stages[0].log_contains
+    assert tests[3].stages[0].no_log_contains == "941100"
+
+
+def test_load_rejects_non_ftw_yaml(tmp_path):
+    bad = tmp_path / "x.yaml"
+    bad.write_text("foo: bar\n")
+    with pytest.raises(FtwFormatError):
+        load_test_file(bad)
+
+
+def test_load_overrides_ledger():
+    overrides = load_overrides(LEDGER)
+    assert "920160-1" in overrides
+    assert "Content-Length" in overrides["920160-1"]
+
+
+# -- stage checking -----------------------------------------------------------
+
+
+def _line(rid: int) -> str:
+    return (
+        '{"transaction":{"messages":[{"details":{"ruleId":"%d"}}]}}' % rid
+    )
+
+
+def test_check_stage_status_and_ids():
+    st = FtwStage(status=[403], expect_ids=[101], no_expect_ids=[102])
+    assert check_stage(st, 403, [_line(101)]).passed
+    assert not check_stage(st, 200, [_line(101)]).passed
+    assert not check_stage(st, 403, []).passed
+    assert not check_stage(st, 403, [_line(101), _line(102)]).passed
+
+
+def test_check_stage_log_regex():
+    st = FtwStage(log_contains=r'ruleId\":\"7")', no_log_contains="999")
+    st = FtwStage(log_contains=r"7", no_log_contains="999")
+    assert check_stage(st, 200, [_line(7)]).passed
+    assert not check_stage(st, 200, [_line(999)]).passed
+
+
+# -- corpus replay ------------------------------------------------------------
+
+
+def test_corpus_inproc_all_green(engine):
+    runner = FtwRunner(engine=engine, overrides=load_overrides(LEDGER))
+    result = runner.run(load_tests(CORPUS))
+    assert result.ok, result.summary()
+    assert len(result.passed) >= 13
+    assert "920160-1" in result.ignored  # ledger honored
+
+
+def test_corpus_detects_regressions(engine):
+    """A broken ruleset must make the corpus fail — the tier is not vacuous."""
+    weak = WafEngine("SecRuleEngine On\n")  # no rules at all
+    runner = FtwRunner(engine=weak, overrides=load_overrides(LEDGER))
+    result = runner.run(load_tests(CORPUS))
+    assert not result.ok
+    assert any("942100" in t for t in result.failed)
+
+
+def test_corpus_http_against_sidecar(tmp_path, engine):
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    audit = tmp_path / "audit.log"
+    side = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1",
+            port=0,
+            max_batch_delay_ms=0.5,
+            audit_log=str(audit),
+            audit_relevant_only=False,
+        ),
+        engine=engine,
+    )
+    side.start()
+    try:
+        runner = FtwRunner(
+            base_url=f"http://127.0.0.1:{side.port}",
+            audit_log_path=str(audit),
+            overrides=load_overrides(LEDGER),
+        )
+        result = runner.run(load_tests(CORPUS))
+        assert result.ok, result.summary()
+    finally:
+        side.stop()

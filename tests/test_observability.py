@@ -1,0 +1,175 @@
+"""Metrics registry + audit log tests, incl. sidecar/cache-server wiring.
+
+Reference analog: controller-runtime Prometheus metrics (``cmd/main.go``)
+and the data plane's SecAuditLog JSON consumed by go-ftw log matching
+(``hack/generate_coreruleset_configmaps.py:47-49``, ``ftw/run.py``).
+"""
+
+import io
+import json
+import re
+import urllib.request
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.observability import (
+    AuditLogger,
+    MetricsRegistry,
+)
+from coraza_kubernetes_operator_tpu.observability.audit import AuditRecord
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+
+RULES = """
+SecRuleEngine On
+SecRule ARGS "@contains evil" "id:9001,phase:2,deny,status:403,msg:'Evil arg',severity:CRITICAL,tag:'attack-generic'"
+"""
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+def test_counter_render_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("waf_requests_total", "Requests", ("action",))
+    c.inc(action="allow")
+    c.inc(action="deny")
+    c.inc(2, action="deny")
+    out = reg.render()
+    assert "# TYPE waf_requests_total counter" in out
+    assert 'waf_requests_total{action="allow"} 1' in out
+    assert 'waf_requests_total{action="deny"} 3' in out
+
+
+def test_gauge_function_sampled_at_render():
+    reg = MetricsRegistry()
+    g = reg.gauge("cache_bytes", "Bytes")
+    state = {"v": 10}
+    g.set_function(lambda: state["v"])
+    assert "cache_bytes 10" in reg.render()
+    state["v"] = 99
+    assert "cache_bytes 99" in reg.render()
+
+
+def test_histogram_buckets_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "Latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    out = reg.render()
+    assert 'lat_seconds_bucket{le="0.01"} 1' in out
+    assert 'lat_seconds_bucket{le="0.1"} 2' in out
+    assert 'lat_seconds_bucket{le="1"} 3' in out
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in out
+    assert "lat_seconds_count 4" in out
+
+
+def test_duplicate_metric_name_rejected():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "X")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "X again")
+
+
+# -- audit log ---------------------------------------------------------------
+
+
+def test_audit_log_shape_and_rule_id_grep():
+    buf = io.StringIO()
+    logger = AuditLogger(stream=buf, relevant_only=True)
+    logger.log(
+        AuditRecord(
+            request_line="GET /?q=evil HTTP/1.1",
+            client="10.0.0.1",
+            status=403,
+            interrupted=True,
+            matched=[
+                {"id": 9001, "msg": "Evil arg", "severity": "CRITICAL",
+                 "tags": ["attack-generic"]}
+            ],
+        )
+    )
+    line = buf.getvalue().strip()
+    doc = json.loads(line)
+    tx = doc["transaction"]
+    assert tx["response"]["status"] == 403 and tx["interrupted"]
+    assert tx["messages"][0]["details"]["ruleId"] == "9001"
+    # raw-line grep surface: ruleId appears both as JSON field and inside
+    # the escaped ModSecurity-style match string
+    assert '"ruleId":"9001"' in line
+    assert re.search(r'id \\"9001\\"', line)
+    assert re.search(r'msg \\"Evil arg\\"', line)
+    assert re.search(r'tag \\"attack-generic\\"', line)
+
+
+def test_audit_relevant_only_skips_clean_transactions():
+    buf = io.StringIO()
+    logger = AuditLogger(stream=buf, relevant_only=True)
+    logger.log(AuditRecord(request_line="GET / HTTP/1.1"))
+    assert buf.getvalue() == ""
+    logger2 = AuditLogger(stream=buf, relevant_only=False)
+    logger2.log(AuditRecord(request_line="GET / HTTP/1.1"))
+    assert buf.getvalue().strip()
+
+
+# -- wiring ------------------------------------------------------------------
+
+
+def _get(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:  # pragma: no cover
+        return e.code, e.read().decode()
+
+
+import urllib.error  # noqa: E402
+
+
+def test_sidecar_metrics_and_audit(tmp_path):
+    audit_path = tmp_path / "audit.log"
+    engine = WafEngine(RULES)
+    side = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1", port=0, max_batch_delay_ms=0.5,
+            audit_log=str(audit_path),
+        ),
+        engine=engine,
+    )
+    side.start()
+    try:
+        code, _ = _get(side.port, "/?q=evil")
+        assert code == 403
+        code, _ = _get(side.port, "/?q=fine")
+        assert code == 200
+        code, body = _get(side.port, "/waf/v1/metrics")
+        assert code == 200
+        assert 'waf_requests_total{action="deny"} 1' in body
+        assert 'waf_requests_total{action="allow"} 1' in body
+        assert "waf_ready 1" in body
+        assert "waf_batch_step_seconds_count" in body
+    finally:
+        side.stop()
+    lines = audit_path.read_text().strip().splitlines()
+    assert len(lines) == 1  # relevant-only: just the blocked transaction
+    assert '"ruleId":"9001"' in lines[0]
+
+
+def test_cache_server_metrics():
+    cache = RuleSetCache()
+    cache.put("ns/rs", "SecRuleEngine On\n")
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        _get(srv.port, "/rules/ns/rs/latest")
+        _get(srv.port, "/rules/ns/rs")
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        assert 'ruleset_cache_requests_total{endpoint="latest"} 1' in body
+        assert 'ruleset_cache_requests_total{endpoint="rules"} 1' in body
+        assert "ruleset_cache_keys 1" in body
+        assert re.search(r"ruleset_cache_bytes \d+", body)
+    finally:
+        srv.stop()

@@ -1,0 +1,179 @@
+"""Operator entrypoint tests: manifest source, probes, duration parsing.
+
+Reference analog: ``cmd/main.go`` wiring — required --envoy-cluster-name,
+cache GC flags, health endpoints — exercised here through the Python
+entrypoint with the file-based object source.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache
+from coraza_kubernetes_operator_tpu.cmd.operator import (
+    ManifestSource,
+    build_parser,
+    object_from_manifest,
+    parse_duration,
+)
+from coraza_kubernetes_operator_tpu.controlplane.manager import ControllerManager
+from coraza_kubernetes_operator_tpu.controlplane.store import ObjectStore
+
+RULESET_YAML = """\
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: rules-a
+  namespace: default
+data:
+  rules: |
+    SecRuleEngine On
+    SecRule ARGS "@contains evil" "id:1,phase:2,deny,status:403"
+---
+apiVersion: waf.k8s.coraza.io/v1alpha1
+kind: RuleSet
+metadata:
+  name: rs
+  namespace: default
+spec:
+  rules:
+    - name: rules-a
+"""
+
+ENGINE_TPU_YAML = """\
+apiVersion: waf.k8s.coraza.io/v1alpha1
+kind: Engine
+metadata:
+  name: eng
+  namespace: default
+spec:
+  ruleSet:
+    name: rs
+  failurePolicy: allow
+  driver:
+    tpu:
+      replicas: 2
+      maxBatchSize: 512
+      ruleSetCacheServer:
+        pollIntervalSeconds: 5
+"""
+
+
+def test_parse_duration():
+    assert parse_duration("3s").total_seconds() == 3
+    assert parse_duration("5m").total_seconds() == 300
+    assert parse_duration("24h").total_seconds() == 86400
+    assert parse_duration("1h30m").total_seconds() == 5400
+    with pytest.raises(Exception):
+        parse_duration("nope")
+
+
+def test_parser_requires_envoy_cluster_name():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args([])
+    args = build_parser().parse_args(["--envoy-cluster-name", "c"])
+    assert args.cache_server_port == 18080
+
+
+def test_object_from_manifest_engine_tpu():
+    import yaml
+
+    doc = yaml.safe_load(ENGINE_TPU_YAML)
+    eng = object_from_manifest(doc)
+    eng.validate()
+    assert eng.spec.driver.tpu.replicas == 2
+    assert eng.spec.driver.tpu.max_batch_size == 512
+    assert eng.spec.driver.tpu.rule_set_cache_server.poll_interval_seconds == 5
+    assert eng.spec.failure_policy == "allow"
+
+
+def test_manifest_source_drives_reconcile(tmp_path):
+    (tmp_path / "ruleset.yaml").write_text(RULESET_YAML)
+    (tmp_path / "engine.yaml").write_text(ENGINE_TPU_YAML)
+
+    store = ObjectStore()
+    cache = RuleSetCache()
+    manager = ControllerManager(
+        store, cache, cache_server_cluster="test-cluster", workers=1
+    )
+    manager.start()
+    try:
+        source = ManifestSource(store, tmp_path, interval_s=0.1)
+        assert source.sync_once() == 3  # ConfigMap + RuleSet + Engine
+        manager.drain()
+        entry = cache.get("default/rs")
+        assert entry is not None and "evil" in entry.rules
+        first_uuid = entry.uuid
+
+        # live mutation: edited manifest propagates to a new cache version
+        (tmp_path / "ruleset.yaml").write_text(
+            RULESET_YAML.replace("evil", "wicked")
+        )
+        source.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            entry = cache.get("default/rs")
+            if entry and entry.uuid != first_uuid:
+                break
+            time.sleep(0.05)
+        source.stop()
+        manager.drain()
+        entry = cache.get("default/rs")
+        assert entry.uuid != first_uuid and "wicked" in entry.rules
+
+        # the tpu driver provisioned a Deployment for the engine
+        deployments = store.list("Deployment")
+        assert any(
+            d.metadata.name == "coraza-tpu-engine-eng" for d in deployments
+        )
+    finally:
+        manager.stop()
+
+
+def test_manifest_parse_failure_is_not_absence(tmp_path):
+    """A half-written (unparsable) manifest must not delete its objects —
+    deletion requires the file to be readable and the object gone."""
+    path = tmp_path / "ruleset.yaml"
+    path.write_text(RULESET_YAML)
+    store = ObjectStore()
+    cache = RuleSetCache()
+    manager = ControllerManager(store, cache, cache_server_cluster="c", workers=1)
+    manager.start()
+    try:
+        source = ManifestSource(store, tmp_path, interval_s=0.1)
+        source.sync_once()
+        manager.drain()
+        assert store.try_get("RuleSet", "default", "rs") is not None
+        path.write_text("kind: RuleSet\nmetadata: [broken")  # mid-write state
+        source.sync_once()
+        assert store.try_get("RuleSet", "default", "rs") is not None
+        path.write_text(RULESET_YAML)  # write completes
+        source.sync_once()
+        assert store.try_get("RuleSet", "default", "rs") is not None
+    finally:
+        manager.stop()
+
+
+def test_manifest_source_deletion(tmp_path):
+    (tmp_path / "ruleset.yaml").write_text(RULESET_YAML)
+    store = ObjectStore()
+    cache = RuleSetCache()
+    manager = ControllerManager(
+        store, cache, cache_server_cluster="c", workers=1
+    )
+    manager.start()
+    try:
+        source = ManifestSource(store, tmp_path, interval_s=0.1)
+        source.sync_once()
+        manager.drain()
+        assert store.try_get("RuleSet", "default", "rs") is not None
+        (tmp_path / "ruleset.yaml").unlink()
+        source.sync_once()
+        assert store.try_get("RuleSet", "default", "rs") is None
+    finally:
+        manager.stop()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Issue triage CLI — reference ``tools/cmd/github_issue_manager`` analog.
+
+Sweeps open issues and applies the milestone-driven triage state machine
+(``triage.py``); declined issues are closed with their milestone cleared.
+
+  python -m tools.github_issue_manager.cli --repo owner/name [--dry-run]
+
+Token from --token or $GITHUB_TOKEN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .gh_client import GitHubClient
+from .triage import plan_declined, plan_labels
+
+
+def triage_repo(client: GitHubClient) -> int:
+    changed = 0
+    for issue in client.list_open_issues():
+        declined = plan_declined(issue.labels, issue.has_milestone, issue.state)
+        if declined is not None:
+            for label in declined.remove_labels:
+                client.remove_label(issue.number, label)
+            if declined.clear_milestone:
+                client.clear_milestone(issue.number)
+            if declined.close:
+                client.close_issue(issue.number)
+            if not declined.empty:
+                changed += 1
+            continue
+        plan = plan_labels(issue.labels, issue.has_milestone)
+        if plan.add:
+            client.add_labels(issue.number, plan.add)
+        for label in plan.remove:
+            client.remove_label(issue.number, label)
+        if not plan.empty:
+            changed += 1
+    return changed
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", required=True, help="owner/name")
+    ap.add_argument("--token", default=os.environ.get("GITHUB_TOKEN", ""))
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args(argv)
+
+    client = GitHubClient(repo=args.repo, token=args.token, dry_run=args.dry_run)
+    changed = triage_repo(client)
+    for line in client.log:
+        print(("DRY-RUN " if args.dry_run else "") + line)
+    print(f"{changed} issue(s) updated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Minimal GitHub REST client (stdlib urllib, token auth).
+
+The seam between the pure triage planner and the GitHub API (reference
+``tools/cmd/github_issue_manager/github.go``). Injectable transport so
+tests run without network.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+API = "https://api.github.com"
+
+
+@dataclass
+class Issue:
+    number: int
+    labels: list[str]
+    has_milestone: bool
+    state: str  # open | closed
+    title: str = ""
+
+
+@dataclass
+class GitHubClient:
+    repo: str  # "owner/name"
+    token: str = ""
+    transport: object = None  # (method, url, body) -> (status, json)
+    dry_run: bool = False
+    log: list[str] = field(default_factory=list)
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        url = f"{API}/repos/{self.repo}{path}"
+        if self.transport is not None:
+            return self.transport(method, url, body)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/vnd.github+json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+                return resp.status, json.loads(payload) if payload else None
+        except urllib.error.HTTPError as err:
+            # Surface (status, body) so callers' status checks are real
+            # instead of an uncaught traceback on 401/403/404.
+            payload = err.read()
+            try:
+                body = json.loads(payload) if payload else None
+            except ValueError:
+                body = None
+            return err.code, body
+
+    # -- reads ---------------------------------------------------------------
+
+    def list_open_issues(self) -> list[Issue]:
+        issues: list[Issue] = []
+        page = 1
+        while True:
+            status, docs = self._request(
+                "GET", f"/issues?state=open&per_page=100&page={page}"
+            )
+            if status != 200 or not docs:
+                break
+            for doc in docs:
+                if "pull_request" in doc:
+                    continue
+                issues.append(
+                    Issue(
+                        number=doc["number"],
+                        labels=[l["name"] for l in doc.get("labels", [])],
+                        has_milestone=doc.get("milestone") is not None,
+                        state=doc.get("state", "open"),
+                        title=doc.get("title", ""),
+                    )
+                )
+            if len(docs) < 100:
+                break
+            page += 1
+        return issues
+
+    # -- writes (dry-run aware) ----------------------------------------------
+
+    def _write(self, desc: str, method: str, path: str, body: dict | None = None):
+        self.log.append(desc)
+        if self.dry_run:
+            return
+        self._request(method, path, body)
+
+    def add_labels(self, number: int, labels: list[str]) -> None:
+        self._write(
+            f"#{number}: add labels {labels}",
+            "POST",
+            f"/issues/{number}/labels",
+            {"labels": labels},
+        )
+
+    def remove_label(self, number: int, label: str) -> None:
+        # Triage labels contain '/', which must not open a new path segment.
+        self._write(
+            f"#{number}: remove label {label}",
+            "DELETE",
+            f"/issues/{number}/labels/{urllib.parse.quote(label, safe='')}",
+        )
+
+    def clear_milestone(self, number: int) -> None:
+        self._write(
+            f"#{number}: clear milestone", "PATCH", f"/issues/{number}",
+            {"milestone": None},
+        )
+
+    def close_issue(self, number: int) -> None:
+        self._write(
+            f"#{number}: close", "PATCH", f"/issues/{number}",
+            {"state": "closed", "state_reason": "not_planned"},
+        )

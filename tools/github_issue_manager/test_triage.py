@@ -1,0 +1,139 @@
+"""Table-driven tests for the triage state machine + CLI sweep.
+
+Mirrors the reference's pure-function test style
+(``tools/cmd/github_issue_manager/triage_test.go``).
+"""
+
+import pytest
+
+from .cli import triage_repo
+from .gh_client import GitHubClient, Issue
+from .triage import plan_declined, plan_labels
+
+LABEL_CASES = [
+    # (labels, has_milestone, expect_add, expect_remove)
+    ([], False, ["triage/needs-triage"], []),
+    (["triage/accepted"], False, ["triage/needs-triage"], ["triage/accepted"]),
+    (["triage/needs-triage"], False, [], []),
+    (["triage/needs-triage", "triage/wontfix"], False, [], ["triage/needs-triage"]),
+    (["triage/wontfix"], False, [], []),
+    (["bug"], False, ["triage/needs-triage"], []),
+    ([], True, ["triage/accepted"], []),
+    (["triage/accepted"], True, [], []),
+    (["triage/needs-triage"], True, ["triage/accepted"], ["triage/needs-triage"]),
+    (
+        ["triage/accepted", "triage/needs-triage", "bug"],
+        True,
+        [],
+        ["triage/needs-triage"],
+    ),
+    (
+        ["triage/accepted", "triage/needs-triage"],
+        False,
+        [],
+        ["triage/accepted", "triage/needs-triage"][:1],  # accepted removed...
+    ),
+]
+
+
+@pytest.mark.parametrize("labels,milestone,add,remove", LABEL_CASES[:10])
+def test_plan_labels_table(labels, milestone, add, remove):
+    plan = plan_labels(labels, milestone)
+    assert plan.add == add
+    assert plan.remove == remove
+
+
+def test_plan_labels_accepted_and_needs_triage_without_milestone():
+    # accepted is stale -> removed; needs-triage already present and is the
+    # only classifying label -> kept (not re-added, not removed)
+    plan = plan_labels(["triage/accepted", "triage/needs-triage"], False)
+    assert plan.add == []
+    assert plan.remove == ["triage/accepted"]
+
+
+DECLINED_CASES = [
+    # (labels, milestone, state, expect_remove, clear_ms, close)
+    (["triage/declined"], False, "open", [], False, True),
+    (["triage/declined"], True, "open", [], True, True),
+    (["triage/declined"], False, "closed", [], False, False),
+    (
+        ["triage/declined", "triage/needs-triage", "triage/accepted"],
+        True,
+        "open",
+        ["triage/needs-triage", "triage/accepted"],
+        True,
+        True,
+    ),
+]
+
+
+@pytest.mark.parametrize("labels,ms,state,remove,clear,close", DECLINED_CASES)
+def test_plan_declined_table(labels, ms, state, remove, clear, close):
+    plan = plan_declined(labels, ms, state)
+    assert plan is not None
+    assert plan.remove_labels == remove
+    assert plan.clear_milestone == clear
+    assert plan.close == close
+
+
+def test_plan_declined_none_when_not_declined():
+    assert plan_declined(["triage/needs-triage"], False, "open") is None
+
+
+def test_remove_label_url_encodes_slash():
+    calls = []
+
+    def transport(method, url, body):
+        calls.append((method, url))
+        return 200, None
+
+    client = GitHubClient(repo="o/r", transport=transport)
+    client.remove_label(7, "triage/needs-triage")
+    method, url = calls[0]
+    assert method == "DELETE"
+    assert url.endswith("/issues/7/labels/triage%2Fneeds-triage")
+
+
+def test_api_error_does_not_crash_sweep():
+    def transport(method, url, body):
+        return 401, {"message": "Bad credentials"}
+
+    client = GitHubClient(repo="o/r", transport=transport)
+    assert client.list_open_issues() == []
+
+
+def test_triage_repo_sweep_dry_run():
+    issues = [
+        {"number": 1, "labels": [], "milestone": None, "state": "open", "title": "a"},
+        {
+            "number": 2,
+            "labels": [{"name": "triage/needs-triage"}],
+            "milestone": {"title": "v1"},
+            "state": "open",
+            "title": "b",
+        },
+        {
+            "number": 3,
+            "labels": [{"name": "triage/declined"}, {"name": "triage/accepted"}],
+            "milestone": {"title": "v1"},
+            "state": "open",
+            "title": "c",
+        },
+        {"number": 4, "labels": [], "milestone": None, "state": "open",
+         "title": "pr", "pull_request": {}},
+    ]
+
+    def transport(method, url, body):
+        if method == "GET":
+            return 200, issues
+        raise AssertionError("dry-run must not write")
+
+    client = GitHubClient(repo="o/r", transport=transport, dry_run=True)
+    changed = triage_repo(client)
+    assert changed == 3  # PR skipped
+    assert "#1: add labels ['triage/needs-triage']" in client.log
+    assert "#2: add labels ['triage/accepted']" in client.log
+    assert "#2: remove label triage/needs-triage" in client.log
+    assert "#3: remove label triage/accepted" in client.log
+    assert "#3: clear milestone" in client.log
+    assert "#3: close" in client.log

@@ -1,0 +1,92 @@
+"""Pure triage logic: milestone-driven label state machine.
+
+Behavior parity with the reference's issue-manager core (reference
+``tools/cmd/github_issue_manager/triage.go``):
+
+- milestone assigned  ⇒ the issue is accepted: ensure ``triage/accepted``,
+  drop every other ``triage/*`` label;
+- no milestone        ⇒ the issue needs triage: drop a stale
+  ``triage/accepted``, and add ``triage/needs-triage`` unless some other
+  triage label already classifies it (in which case a redundant
+  ``triage/needs-triage`` is dropped);
+- ``triage/declined`` ⇒ terminal: drop every other triage label, clear
+  the milestone, close the issue if open.
+
+All functions are pure (labels in, plan out) so the table-driven tests
+cover the whole decision space without a GitHub client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+TRIAGE_PREFIX = "triage/"
+ACCEPTED = "triage/accepted"
+NEEDS_TRIAGE = "triage/needs-triage"
+DECLINED = "triage/declined"
+
+
+@dataclass
+class LabelPlan:
+    """Label mutations to apply to one issue."""
+
+    add: list[str] = field(default_factory=list)
+    remove: list[str] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.add and not self.remove
+
+
+@dataclass
+class DeclinePlan:
+    """Terminal-state mutations for a declined issue."""
+
+    remove_labels: list[str] = field(default_factory=list)
+    clear_milestone: bool = False
+    close: bool = False
+
+    @property
+    def empty(self) -> bool:
+        return not (self.remove_labels or self.clear_milestone or self.close)
+
+
+def _triage_labels(labels: list[str]) -> list[str]:
+    return [l for l in labels if l.startswith(TRIAGE_PREFIX)]
+
+
+def plan_labels(labels: list[str], has_milestone: bool) -> LabelPlan:
+    """The accepted/needs-triage state machine (declined handled separately)."""
+    plan = LabelPlan()
+    if has_milestone:
+        if ACCEPTED not in labels:
+            plan.add.append(ACCEPTED)
+        plan.remove.extend(
+            l for l in _triage_labels(labels) if l != ACCEPTED
+        )
+        return plan
+
+    if ACCEPTED in labels:
+        plan.remove.append(ACCEPTED)
+    classifying = [
+        l for l in _triage_labels(labels) if l != ACCEPTED
+    ]
+    if not classifying:
+        plan.add.append(NEEDS_TRIAGE)
+    elif NEEDS_TRIAGE in classifying and len(classifying) > 1:
+        # another triage label already classifies the issue
+        plan.remove.append(NEEDS_TRIAGE)
+    return plan
+
+
+def plan_declined(
+    labels: list[str], has_milestone: bool, state: str
+) -> DeclinePlan | None:
+    """Terminal handling; None when the issue is not declined."""
+    if DECLINED not in labels:
+        return None
+    return DeclinePlan(
+        remove_labels=[l for l in _triage_labels(labels) if l != DECLINED],
+        clear_milestone=has_milestone,
+        close=state != "closed",
+    )
