@@ -33,7 +33,9 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
     p.add_argument(
         "--cache-server-instance",
         required=True,
-        help="RuleSet cache key 'namespace/name' to poll",
+        help="RuleSet cache key 'namespace/name' to poll; a comma-separated"
+        " list serves multiple tenants (first is the default, others are"
+        " selected per request via X-Waf-Tenant)",
     )
     p.add_argument(
         "--cache-server-cluster",

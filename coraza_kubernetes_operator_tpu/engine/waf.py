@@ -80,6 +80,12 @@ class WafEngine:
                 if link.group >= 0 and self.compiled.group_pipeline[link.group] == pid:
                     kinds.update(link.include_kinds)
             self._host_pipeline_kinds.append(kinds)
+        # Native host runtime (C++ extraction + tensorization); falls back
+        # to the Python path when the library is absent or the ruleset uses
+        # transforms the native tier does not implement.
+        from ..native import NativeTensorizer
+
+        self._native = NativeTensorizer(self.compiled)
         if self.compiled.report.skipped:
             log.info(
                 "compiled with skipped rules",
@@ -87,6 +93,10 @@ class WafEngine:
                 rules=self.compiled.n_rules,
                 groups=self.compiled.n_groups,
             )
+
+    @property
+    def native_enabled(self) -> bool:
+        return self._native.available
 
     # -- batching -----------------------------------------------------------
 
@@ -173,14 +183,23 @@ class WafEngine:
         """Evaluate a request batch; returns one Verdict per request."""
         if not requests:
             return []
-        extractions = [self.extractor.extract(r) for r in requests]
-        tensors = self._tensorize(extractions)
-        out = jax.device_get(eval_waf(self.model, *tensors))  # one transfer
-        matched = out["matched"]
-        interrupted = out["interrupted"]
-        status = out["status"]
-        rule_index = out["rule_index"]
-        scores = out["scores"]
+        if self._native.available:
+            tensors = self._native.tensorize(requests)
+        else:
+            extractions = [self.extractor.extract(r) for r in requests]
+            tensors = self._tensorize(extractions)
+        from ..models.waf_model import eval_waf_compact, unpack_compact
+
+        # One small transfer: device->host readback dominates serving once
+        # the host path is native (matched is bit-packed on device and the
+        # verdict tensors ride a single packed array).
+        packed = jax.device_get(eval_waf_compact(self.model, *tensors))
+        head, matched, scores = unpack_compact(
+            packed, self.model.n_rules, self.model.n_counters
+        )
+        interrupted = head[:, 0] != 0
+        status = head[:, 1]
+        rule_index = head[:, 2]
 
         counters = list(enumerate(self.compiled.counters))
         verdicts: list[Verdict] = []

@@ -131,17 +131,19 @@ def load_test_file(path: str | Path) -> list[FtwTest]:
 
 
 def load_tests(root: str | Path) -> list[FtwTest]:
-    """Recursively load every ``*.yaml`` test file under ``root``."""
+    """Recursively load every go-ftw test file under ``root``. Files that
+    are not ftw test files (no ``tests`` key, or unparsable) are skipped —
+    a stray fixture must not abort the whole conformance run."""
     root = Path(root)
     tests: list[FtwTest] = []
-    for path in sorted(root.rglob("*.yaml")):
+    paths = sorted(root.rglob("*.yaml")) + sorted(root.rglob("*.yml"))
+    for path in paths:
         if path.name == "ftw.yml":
             continue
-        tests.extend(load_test_file(path))
-    for path in sorted(root.rglob("*.yml")):
-        if path.name == "ftw.yml":
+        try:
+            tests.extend(load_test_file(path))
+        except (FtwFormatError, yaml.YAMLError):
             continue
-        tests.extend(load_test_file(path))
     return tests
 
 

@@ -22,8 +22,6 @@ import io
 import json
 import re
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -146,30 +144,49 @@ class FtwRunner:
         status = verdict.status if verdict.interrupted else 200
         return status, buf.getvalue().splitlines()
 
-    def _run_stage_http(self, stage: FtwStage) -> tuple[int, list[str]]:
+    def _run_stage_http(self, stage: FtwStage) -> tuple[int | None, list[str]]:
+        """Returns (status, audit lines); status None = transport failure
+        (always a test failure — a dead target must not pass
+        negative-assertion-only tests vacuously)."""
         assert self.base_url is not None
         mark = 0
         audit = Path(self.audit_log_path) if self.audit_log_path else None
         if audit is not None and audit.exists():
             mark = audit.stat().st_size
-        req = urllib.request.Request(
-            self.base_url + stage.uri,
-            method=stage.method,
-            data=stage.data or None,
-            headers=dict(stage.headers),
-        )
+        # http.client directly: go-ftw corpora use repeated header names
+        # (e.g. duplicate Cookie), which a dict-based API would collapse.
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(self.base_url)
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                status = resp.status
-                resp.read()
-        except urllib.error.HTTPError as err:
-            status = err.code
-            err.read()
-        except urllib.error.URLError as err:
-            # Connection refused/reset (sidecar restarting): fail this test
-            # with a status the assertions can report, don't abort the run.
+            conn = http.client.HTTPConnection(
+                parts.hostname, parts.port or 80, timeout=30
+            )
+            conn.putrequest(
+                stage.method, stage.uri, skip_host=True, skip_accept_encoding=True
+            )
+            has_host = any(k.lower() == "host" for k, _ in stage.headers)
+            if not has_host:
+                conn.putheader("Host", parts.netloc)
+            for k, v in stage.headers:
+                conn.putheader(k, v)
+            if stage.data and not any(
+                k.lower() == "content-length" for k, _ in stage.headers
+            ):
+                conn.putheader("Content-Length", str(len(stage.data)))
+            conn.endheaders()
+            if stage.data:
+                conn.send(stage.data)
+            resp = conn.getresponse()
+            status: int | None = resp.status
+            resp.read()
+            conn.close()
+        except OSError as err:
+            # Connection refused/reset (target down): fail this test, but
+            # keep the run going for the remaining corpus.
             log.error("stage request failed", err, uri=stage.uri)
-            return 0, []
+            return None, []
         lines: list[str] = []
         if audit is not None:
             # the sidecar flushes per line; small settle loop for batching
@@ -197,6 +214,9 @@ class FtwRunner:
                     status, lines = self._run_stage_inproc(stage)
                 else:
                     status, lines = self._run_stage_http(stage)
+                if status is None:
+                    failure = f"stage {i}: transport failure (target unreachable)"
+                    break
                 outcome = check_stage(stage, status, lines)
                 if not outcome.passed:
                     failure = f"stage {i}: {outcome.reason}"

@@ -430,3 +430,44 @@ def post_match(
         "rule_index": rule_index,  # [B]
         "scores": counters,  # [B, C]
     }
+
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
+    """eval_waf with every verdict tensor packed into ONE int32 array
+    [B, 3 + ceil(Rr/8)/4 + C]: columns 0-2 are (interrupted, status,
+    rule_index), then bit-packed matched words, then the counters.
+    Serving reads ~25x fewer bytes in ONE transfer — device->host
+    readback (per-transfer round trips + bandwidth) is the serving
+    bottleneck once the host path is native. Unpack with
+    ``unpack_compact``."""
+    out = eval_waf.__wrapped__(model, *tensors, max_phase=max_phase)
+    b = out["status"].shape[0]
+    head = jnp.stack(
+        [
+            out["interrupted"].astype(jnp.int32),
+            out["status"].astype(jnp.int32),
+            out["rule_index"].astype(jnp.int32),
+        ],
+        axis=1,
+    )  # [B, 3]
+    bits = jnp.packbits(out["matched"].astype(jnp.uint8), axis=1)
+    nb = bits.shape[1]
+    pad = (-nb) % 4
+    bits = jnp.pad(bits, ((0, 0), (0, pad)))
+    words = jax.lax.bitcast_convert_type(
+        bits.reshape(b, (nb + pad) // 4, 4), jnp.int32
+    )  # [B, nw]
+    return jnp.concatenate([head, words, out["scores"]], axis=1)
+
+
+def unpack_compact(packed: np.ndarray, n_rules: int, n_counters: int):
+    """Host-side split of eval_waf_compact's packed array (numpy)."""
+    nb = (n_rules + 7) // 8
+    nw = (nb + 3) // 4
+    head = packed[:, :3]
+    words = np.ascontiguousarray(packed[:, 3 : 3 + nw])
+    bits = words.view(np.uint8).reshape(packed.shape[0], nw * 4)[:, :nb]
+    matched = np.unpackbits(bits, axis=1, count=n_rules).astype(bool)
+    scores = packed[:, 3 + nw : 3 + nw + n_counters]
+    return head, matched, scores

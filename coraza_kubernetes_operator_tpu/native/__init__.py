@@ -1,0 +1,286 @@
+"""ctypes bridge to the C++ host runtime (native/libcko_native.so).
+
+The native library implements request extraction + batch tensorization
+(the Python reference lives in ``engine/request.py`` + ``engine/waf.py``);
+this module serializes the compiled-ruleset context it needs, feeds it
+request batches, and exposes ``NativeTensorizer`` with the same output
+tuple as ``WafEngine._tensorize``. Engines fall back to the Python path
+when the library is absent (``CKO_NATIVE=0`` forces that) or when a host
+pipeline uses a transform the native tier does not implement (md5/sha1).
+
+Differential tests in ``tests/test_native.py`` hold the two paths
+bit-for-bit equal on randomized requests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from ..engine.request import HttpRequest
+
+# Transform opcode order — must match TransformOp in native/src/cko_native.cpp.
+_OPCODES = {
+    "none": 0, "lowercase": 1, "uppercase": 2, "urldecode": 3,
+    "urldecodeuni": 4, "urlencode": 5, "htmlentitydecode": 6,
+    "removenulls": 7, "replacenulls": 8, "removewhitespace": 9,
+    "compresswhitespace": 10, "trim": 11, "trimleft": 12, "trimright": 13,
+    "removecomments": 14, "removecommentschar": 15, "replacecomments": 16,
+    "normalizepath": 17, "normalisepath": 17, "normalizepathwin": 18,
+    "normalisepathwin": 18, "cmdline": 19, "jsdecode": 20, "cssdecode": 21,
+    "base64decode": 22, "base64decodeext": 23, "base64encode": 24,
+    "hexdecode": 25, "hexencode": 26, "escapeseqdecode": 27,
+    "utf8tounicode": 28, "length": 29,
+}
+
+# Collection enum — must match Coll in cko_native.cpp.
+_COLLECTION_IDS = {
+    "ARGS": 0, "ARGS_GET": 1, "ARGS_POST": 2, "ARGS_NAMES": 3,
+    "ARGS_GET_NAMES": 4, "ARGS_POST_NAMES": 5, "REQUEST_HEADERS": 6,
+    "REQUEST_HEADERS_NAMES": 7, "REQUEST_COOKIES": 8,
+    "REQUEST_COOKIES_NAMES": 9,
+}
+
+# Scalar order — must match ScalarId in cko_native.cpp and the scalars dict
+# in engine/request.py:extract.
+_SCALAR_ORDER = [
+    "REQUEST_URI", "REQUEST_URI_RAW", "REQUEST_FILENAME", "REQUEST_BASENAME",
+    "REQUEST_LINE", "REQUEST_METHOD", "REQUEST_PROTOCOL", "QUERY_STRING",
+    "REQUEST_BODY", "FULL_REQUEST", "PATH_INFO", "REMOTE_ADDR",
+    "SERVER_NAME", "STATUS_LINE", "RESPONSE_BODY", "AUTH_TYPE",
+    "REQBODY_PROCESSOR",
+]
+
+# Numeric order — must match NumId and the numeric_values dict.
+_NUMERIC_ORDER = [
+    "REQUEST_BODY_LENGTH", "REQBODY_ERROR", "MULTIPART_STRICT_ERROR",
+    "MULTIPART_UNMATCHED_BOUNDARY", "ARGS_COMBINED_SIZE",
+    "FULL_REQUEST_LENGTH", "FILES_COMBINED_SIZE", "RESPONSE_STATUS",
+    "DURATION",
+]
+
+
+
+
+def _lib_path() -> Path | None:
+    env = os.environ.get("CKO_NATIVE_LIB")
+    if env:
+        return Path(env) if Path(env).exists() else None
+    p = Path(__file__).resolve().parent.parent.parent / "native" / "libcko_native.so"
+    return p if p.exists() else None
+
+
+_lib = None
+
+
+def load_library():
+    """Load (once) and return the native library, or None."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("CKO_NATIVE", "1") == "0":
+        return None
+    path = _lib_path()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.cko_ctx_new.restype = ctypes.c_void_p
+    lib.cko_ctx_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.cko_ctx_free.argtypes = [ctypes.c_void_p]
+    lib.cko_tensorize.restype = ctypes.c_void_p
+    lib.cko_tensorize.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int
+    ]
+    lib.cko_result_rows.argtypes = [ctypes.c_void_p]
+    lib.cko_result_maxlen.argtypes = [ctypes.c_void_p]
+    lib.cko_result_export.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 9 + [
+        ctypes.c_int
+    ] * 6
+    lib.cko_result_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def _pack_str(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+def serialize_config(crs) -> bytes | None:
+    """Build the context blob for cko_ctx_new. None when the ruleset uses
+    features the native tier does not support (exotic host transforms)."""
+    out = [struct.pack("<II", int(crs.program.request_body_access),
+                       crs.program.request_body_limit)]
+    out.append(struct.pack("<I", crs.vocab.n_kinds))
+
+    entries = []
+    for (coll, sel), kind in crs.vocab.kinds.items():
+        cid = _COLLECTION_IDS.get(coll)
+        if cid is None:
+            continue  # scalars handled below; unextracted collections unused
+        sel_b = (sel or "").encode("latin-1", "replace")
+        entries.append(struct.pack("<BH", cid, len(sel_b)) + sel_b
+                       + struct.pack("<I", kind))
+    out.append(struct.pack("<I", len(entries)))
+    out.extend(entries)
+
+    regex = []
+    for coll, _pat, kid in crs.vocab.regex_kinds:
+        cid = _COLLECTION_IDS.get(coll)
+        if cid is None:
+            continue
+        dfa = crs.vocab._regex_dfas[kid]
+        s, c = dfa.n_states, dfa.n_classes
+        blob = struct.pack("<BIIIB", cid, kid, s, c, int(dfa.always_match))
+        blob += np.asarray(dfa.classmap, dtype=np.uint16).tobytes()
+        blob += np.ascontiguousarray(dfa.trans, dtype=np.uint32).tobytes()
+        blob += np.ascontiguousarray(dfa.emit, dtype=np.uint8).tobytes()
+        blob += np.ascontiguousarray(dfa.match_end, dtype=np.uint8).tobytes()
+        regex.append(blob)
+    out.append(struct.pack("<I", len(regex)))
+    out.extend(regex)
+
+    for name in _SCALAR_ORDER:
+        out.append(struct.pack("<I", crs.vocab.lookup(name, None) or 0))
+    for name in _NUMERIC_ORDER:
+        out.append(struct.pack("<I", crs.vocab.lookup(name, None) or 0))
+
+    # Host pipelines in slot order, with their member-kind sets (the kinds
+    # some rule under that pipeline can see — engine/waf.py logic).
+    host_pipelines = crs.host_pipelines()
+    pipes = []
+    for pid, names in host_pipelines:
+        ops = []
+        for n in names:
+            op = _OPCODES.get(n)
+            if op is None:
+                return None  # unsupported transform -> python fallback
+            ops.append(op)
+        kinds: set[int] = set()
+        for link in crs.links:
+            if link.group >= 0 and crs.group_pipeline[link.group] == pid:
+                kinds.update(link.include_kinds)
+        blob = struct.pack("<I", len(ops)) + bytes(ops)
+        blob += struct.pack("<I", len(kinds))
+        blob += b"".join(struct.pack("<I", k) for k in sorted(kinds))
+        pipes.append(blob)
+    out.append(struct.pack("<I", len(pipes)))
+    out.extend(pipes)
+
+    nv_specs = sorted(crs.numvars.vars.items(), key=lambda kv: kv[1])
+    nv_blobs = []
+    for key, _slot in nv_specs:
+        if key[0] == "scalar":
+            try:
+                sid = _NUMERIC_ORDER.index(key[1])
+            except ValueError:
+                sid = 0xFF  # unknown scalar evaluates to 0
+            nv_blobs.append(struct.pack("<BB", 0, sid))
+        else:
+            _, coll, sel = key
+            cid = _COLLECTION_IDS.get(coll)
+            if cid is None:
+                return None  # counting an unextracted collection
+            sel_b = (sel or "").encode("latin-1", "replace")
+            nv_blobs.append(
+                struct.pack("<BBBH", 1, cid, int(sel is not None), len(sel_b))
+                + sel_b
+            )
+    out.append(struct.pack("<I", len(nv_blobs)))
+    out.extend(nv_blobs)
+    return b"".join(out)
+
+
+def serialize_requests(requests: list[HttpRequest]) -> bytes:
+    parts = []
+    for r in requests:
+        parts.append(_pack_str(r.method.encode("latin-1", "replace")))
+        parts.append(_pack_str(r.uri.encode("latin-1", "replace")))
+        parts.append(_pack_str(r.version.encode("latin-1", "replace")))
+        parts.append(struct.pack("<I", len(r.headers)))
+        for k, v in r.headers:
+            parts.append(_pack_str(str(k).encode("latin-1", "replace")))
+            parts.append(_pack_str(str(v).encode("latin-1", "replace")))
+        body = r.body if isinstance(r.body, bytes) else str(r.body).encode()
+        parts.append(_pack_str(body))
+        parts.append(_pack_str(r.remote_addr.encode("latin-1", "replace")))
+    return b"".join(parts)
+
+
+# Shape bucketing must stay bit-for-bit identical to the Python path.
+from ..engine.waf import _MIN_LEN, _bucket  # noqa: E402
+
+
+class NativeTensorizer:
+    """Holds a native context for one compiled ruleset; produces the same
+    tensor tuple as ``WafEngine._tensorize`` directly from requests."""
+
+    def __init__(self, crs):
+        self._lib = load_library()
+        self._ctx = None
+        if self._lib is None:
+            return
+        blob = serialize_config(crs)
+        if blob is None:
+            return
+        ctx = self._lib.cko_ctx_new(blob, len(blob))
+        if not ctx:
+            return
+        self._ctx = ctx
+        self._n_host = len(crs.host_pipelines())
+        self._nv = crs.numvars.n_vars
+
+    @property
+    def available(self) -> bool:
+        return self._ctx is not None
+
+    def tensorize(self, requests: list[HttpRequest]):
+        assert self._ctx is not None
+        blob = serialize_requests(requests)
+        res = self._lib.cko_tensorize(self._ctx, blob, len(blob), len(requests))
+        if not res:
+            raise RuntimeError("native tensorize failed (malformed batch blob)")
+        try:
+            n_rows = self._lib.cko_result_rows(res)
+            max_len = self._lib.cko_result_maxlen(res)
+            n_req = _bucket(max(1, len(requests)))
+            t = _bucket(max(1, n_rows))
+            length = _bucket(max(_MIN_LEN, max_len))
+            h = max(1, self._n_host)
+
+            data = np.zeros((t, length), dtype=np.uint8)
+            lengths = np.zeros(t, dtype=np.int32)
+            k1 = np.zeros(t, dtype=np.int32)
+            k2 = np.zeros(t, dtype=np.int32)
+            k3 = np.zeros(t, dtype=np.int32)
+            req_id = np.zeros(t, dtype=np.int32)
+            vdata = np.zeros((h, t, length), dtype=np.uint8)
+            vlengths = np.zeros((h, t), dtype=np.int32)
+            numvals = np.zeros((n_req, self._nv), dtype=np.int32)
+
+            rc = self._lib.cko_result_export(
+                res,
+                data.ctypes.data_as(ctypes.c_void_p),
+                lengths.ctypes.data_as(ctypes.c_void_p),
+                k1.ctypes.data_as(ctypes.c_void_p),
+                k2.ctypes.data_as(ctypes.c_void_p),
+                k3.ctypes.data_as(ctypes.c_void_p),
+                req_id.ctypes.data_as(ctypes.c_void_p),
+                vdata.ctypes.data_as(ctypes.c_void_p),
+                vlengths.ctypes.data_as(ctypes.c_void_p),
+                numvals.ctypes.data_as(ctypes.c_void_p),
+                t, length, self._n_host, n_req, self._nv, n_req,
+            )
+            if rc != 0:
+                raise RuntimeError(f"native export failed rc={rc}")
+        finally:
+            self._lib.cko_result_free(res)
+        return (data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths)
+
+    def __del__(self):
+        if self._ctx is not None and self._lib is not None:
+            self._lib.cko_ctx_free(self._ctx)
+            self._ctx = None

@@ -87,10 +87,19 @@ class MicroBatcher:
         max_batch_size: int = DEFAULT_MAX_BATCH_SIZE,
         max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
     ):
-        self._engine_fn = engine_fn
+        # engine_fn(tenant) -> WafEngine | None. Single-tenant callers may
+        # pass a zero-arg callable; it is adapted below.
+        import inspect
+
+        if len(inspect.signature(engine_fn).parameters) == 0:
+            self._engine_fn = lambda _tenant: engine_fn()
+        else:
+            self._engine_fn = engine_fn
         self.max_batch_size = max(1, int(max_batch_size))
         self.max_batch_delay_s = max(0.0, float(max_batch_delay_ms)) / 1e3
-        self._queue: queue.Queue[tuple[HttpRequest, Future] | None] = queue.Queue()
+        self._queue: queue.Queue[
+            tuple[HttpRequest, str | None, Future] | None
+        ] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._running = False
         self.stats = BatcherStats()
@@ -118,16 +127,18 @@ class MicroBatcher:
             except queue.Empty:
                 return
             if item is not None:
-                item[1].set_exception(err)
+                item[2].set_exception(err)
 
-    def submit(self, request: HttpRequest) -> Future:
+    def submit(self, request: HttpRequest, tenant: str | None = None) -> Future:
         """Enqueue one request; the Future resolves to its Verdict."""
         fut: Future = Future()
-        self._queue.put((request, fut))
+        self._queue.put((request, tenant, fut))
         return fut
 
-    def evaluate(self, request: HttpRequest, timeout_s: float = 30.0) -> Verdict:
-        return self.submit(request).result(timeout=timeout_s)
+    def evaluate(
+        self, request: HttpRequest, timeout_s: float = 30.0, tenant: str | None = None
+    ) -> Verdict:
+        return self.submit(request, tenant=tenant).result(timeout=timeout_s)
 
     # -- batch loop ----------------------------------------------------------
 
@@ -137,9 +148,9 @@ class MicroBatcher:
             if item is None:
                 continue
             if not self._running:
-                item[1].set_exception(EngineUnavailable("batcher stopped"))
+                item[2].set_exception(EngineUnavailable("batcher stopped"))
                 continue
-            window: list[tuple[HttpRequest, Future]] = [item]
+            window: list[tuple[HttpRequest, str | None, Future]] = [item]
             deadline = time.monotonic() + self.max_batch_delay_s
             while len(window) < self.max_batch_size:
                 remaining = deadline - time.monotonic()
@@ -154,26 +165,40 @@ class MicroBatcher:
                 window.append(nxt)
             self._evaluate_window(window)
 
-    def _evaluate_window(self, window: list[tuple[HttpRequest, Future]]) -> None:
-        engine: WafEngine | None = self._engine_fn()
-        if engine is None:
-            err = EngineUnavailable("no compiled ruleset loaded")
-            self.stats.errors += len(window)
-            for _, fut in window:
-                fut.set_exception(err)
-            return
+    def _evaluate_window(
+        self, window: list[tuple[HttpRequest, str | None, Future]]
+    ) -> None:
+        # Group the window by tenant: each tenant's compiled ruleset is a
+        # separate device model, so one device step runs per tenant present
+        # in the window (BASELINE multi-tenant config).
+        groups: dict[str | None, list[int]] = {}
+        for idx, (_req, tenant, _fut) in enumerate(window):
+            groups.setdefault(tenant, []).append(idx)
         t0 = time.monotonic()
-        try:
-            verdicts = engine.evaluate([r for r, _ in window])
-        except Exception as err:  # evaluation failure → per-request error
-            log.error("batch evaluation failed", err, batch=len(window))
-            self.stats.errors += len(window)
-            for _, fut in window:
-                fut.set_exception(err)
-            return
-        self.stats.record(len(window), time.monotonic() - t0)
-        for (_, fut), verdict in zip(window, verdicts):
-            fut.set_result(verdict)
+        evaluated = 0
+        for tenant, idxs in groups.items():
+            engine: WafEngine | None = self._engine_fn(tenant)
+            if engine is None:
+                err = EngineUnavailable(
+                    f"no compiled ruleset loaded for tenant {tenant!r}"
+                )
+                self.stats.errors += len(idxs)
+                for i in idxs:
+                    window[i][2].set_exception(err)
+                continue
+            try:
+                verdicts = engine.evaluate([window[i][0] for i in idxs])
+            except Exception as err:  # evaluation failure → per-request error
+                log.error("batch evaluation failed", err, batch=len(idxs))
+                self.stats.errors += len(idxs)
+                for i in idxs:
+                    window[i][2].set_exception(err)
+                continue
+            for i, verdict in zip(idxs, verdicts):
+                window[i][2].set_result(verdict)
+            evaluated += len(idxs)
+        if evaluated:
+            self.stats.record(evaluated, time.monotonic() - t0)
 
 
 class EngineUnavailable(RuntimeError):

@@ -43,7 +43,8 @@ from .batcher import (
     EngineUnavailable,
     MicroBatcher,
 )
-from .reloader import DEFAULT_POLL_INTERVAL_S, RuleReloader
+from .reloader import DEFAULT_POLL_INTERVAL_S
+from .tenants import TENANT_HEADER, TenantManager
 
 log = get_logger("sidecar.server")
 
@@ -58,6 +59,9 @@ class SidecarConfig:
     (``controlplane/engine_controller.py:build_tpu_engine_deployment``)."""
 
     cache_base_url: str = "http://127.0.0.1:18080"
+    # One or more RuleSet cache keys, comma-separated. The first is the
+    # default tenant; filter-mode requests select others via the
+    # X-Waf-Tenant header, bulk requests via a per-request "tenant" field.
     instance_key: str = "default/ruleset"
     poll_interval_s: float = DEFAULT_POLL_INTERVAL_S
     failure_policy: str = FAILURE_POLICY_FAIL
@@ -70,6 +74,12 @@ class SidecarConfig:
     # SecAuditLog /dev/stdout shape), anything else a file path.
     audit_log: str | None = None
     audit_relevant_only: bool = True
+    # Honor X-Waf-Tenant on FILTER-mode requests. Off by default: in filter
+    # mode that header arrives from the (untrusted) client, and selecting a
+    # lenient tenant's ruleset would be a WAF bypass. Enable only when a
+    # trusted proxy in front sets/strips the header. The bulk API (an
+    # internal surface) always honors per-request tenants.
+    trust_tenant_header: bool = False
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -197,8 +207,11 @@ class _Handler(BaseHTTPRequestHandler):
             body=body,
             remote_addr=self.client_address[0],
         )
+        tenant = None
+        if self.sidecar.config.trust_tenant_header:
+            tenant = self.headers.get(TENANT_HEADER) or None
         try:
-            verdict = self.sidecar.evaluate(req)
+            verdict = self.sidecar.evaluate(req, tenant=tenant)
         except EngineUnavailable:
             self._unavailable()
             return
@@ -206,7 +219,7 @@ class _Handler(BaseHTTPRequestHandler):
             log.error("filter evaluation failed", err)
             self._unavailable()
             return
-        self.sidecar.record_verdict(req, verdict)
+        self.sidecar.record_verdict(req, verdict, tenant=tenant)
         if verdict.interrupted:
             self._reply(
                 verdict.status,
@@ -225,14 +238,18 @@ class _Handler(BaseHTTPRequestHandler):
             )
 
     def _handle_bulk(self, body: bytes) -> None:
+        default_tenant = self.headers.get(TENANT_HEADER) or None
         try:
             payload = json.loads(body.decode("utf-8"))
             reqs = [request_from_json(o) for o in payload["requests"]]
-        except (ValueError, KeyError, TypeError) as err:
+            tenants = [
+                o.get("tenant") or default_tenant for o in payload["requests"]
+            ]
+        except (ValueError, KeyError, TypeError, AttributeError) as err:
             self._reply_json(400, {"error": f"invalid request payload: {err}"})
             return
         try:
-            verdicts = self.sidecar.evaluate_many(reqs)
+            verdicts = self.sidecar.evaluate_many(reqs, tenants=tenants)
         except EngineUnavailable:
             self._unavailable()
             return
@@ -240,8 +257,8 @@ class _Handler(BaseHTTPRequestHandler):
             log.error("bulk evaluation failed", err)  # dropped connection
             self._reply_json(500, {"error": f"evaluation failed: {err}"})
             return
-        for r, v in zip(reqs, verdicts):
-            self.sidecar.record_verdict(r, v)
+        for r, v, t in zip(reqs, verdicts, tenants):
+            self.sidecar.record_verdict(r, v, tenant=t)
         self._reply_json(200, {"verdicts": [verdict_to_json(v) for v in verdicts]})
 
     def _unavailable(self) -> None:
@@ -270,15 +287,16 @@ class TpuEngineSidecar:
 
     def __init__(self, config: SidecarConfig, engine: WafEngine | None = None):
         self.config = config
-        self.reloader = RuleReloader(
+        keys = [k.strip() for k in config.instance_key.split(",") if k.strip()]
+        self.tenants = TenantManager(
             cache_base_url=config.cache_base_url,
-            instance_key=config.instance_key,
+            tenant_keys=keys or ["default/ruleset"],
             poll_interval_s=config.poll_interval_s,
         )
         if engine is not None:  # pre-seeded (tests / static rules)
-            self.reloader.seed(engine)
+            self.tenants.seed(self.tenants.default_tenant, engine)
         self.batcher = MicroBatcher(
-            engine_fn=lambda: self.reloader.engine,
+            engine_fn=lambda tenant: self.tenants.engine_for(tenant),
             max_batch_size=config.max_batch_size,
             max_batch_delay_ms=config.max_batch_delay_ms,
         )
@@ -301,11 +319,14 @@ class TpuEngineSidecar:
         )
         self._m_ready.set_function(lambda: 1.0 if self.ready() else 0.0)
         self.metrics.gauge(
-            "waf_ruleset_reloads", "Successful hot reloads"
-        ).set_function(lambda: float(self.reloader.reloads))
+            "waf_ruleset_reloads", "Successful hot reloads (all tenants)"
+        ).set_function(lambda: float(self.tenants.total_reloads))
         self.metrics.gauge(
-            "waf_ruleset_reload_failures", "Failed hot reloads"
-        ).set_function(lambda: float(self.reloader.failed_reloads))
+            "waf_ruleset_reload_failures", "Failed hot reloads (all tenants)"
+        ).set_function(lambda: float(self.tenants.total_failed_reloads))
+        self.metrics.gauge(
+            "waf_tenants", "Resident tenant rulesets"
+        ).set_function(lambda: float(len(self.tenants.tenants)))
         self.batcher.stats.on_batch = self._on_batch
         self.audit: AuditLogger | None = None
         if config.audit_log == "-":
@@ -325,12 +346,14 @@ class TpuEngineSidecar:
         self._m_batch_size.observe(size)
         self._m_step.observe(latency_s)
 
-    def record_verdict(self, request: HttpRequest, verdict: Verdict) -> None:
+    def record_verdict(
+        self, request: HttpRequest, verdict: Verdict, tenant: str | None = None
+    ) -> None:
         """Per-request accounting: metrics counter + audit log line."""
         self._m_requests.inc(action="deny" if verdict.interrupted else "allow")
         if self.audit is None:
             return
-        engine = self.reloader.engine
+        engine = self.tenants.engine_for(tenant)
         meta = engine.rule_meta if engine is not None else {}
         self.audit.log(
             AuditRecord(
@@ -341,7 +364,7 @@ class TpuEngineSidecar:
                 matched=[
                     meta.get(rid, {"id": rid}) for rid in verdict.matched_ids
                 ],
-                tenant=self.config.instance_key,
+                tenant=(tenant or self.tenants.default_tenant or ""),
             )
         )
 
@@ -350,27 +373,37 @@ class TpuEngineSidecar:
         return self._httpd.server_address[1]
 
     def ready(self) -> bool:
-        return self.reloader.engine is not None
+        return self.tenants.any_loaded()
+
+    @property
+    def reloader(self):
+        """Back-compat shim: the default tenant's reloader."""
+        return self.tenants._reloaders[self.tenants.default_tenant]
 
     # -- evaluation ----------------------------------------------------------
 
-    def evaluate(self, request: HttpRequest) -> Verdict:
-        if self.reloader.engine is None:
-            raise EngineUnavailable("no compiled ruleset loaded")
-        return self.batcher.evaluate(request, timeout_s=self.config.request_timeout_s)
+    def evaluate(self, request: HttpRequest, tenant: str | None = None) -> Verdict:
+        if self.tenants.engine_for(tenant) is None:
+            raise EngineUnavailable(f"no compiled ruleset loaded for {tenant!r}")
+        return self.batcher.evaluate(
+            request, timeout_s=self.config.request_timeout_s, tenant=tenant
+        )
 
-    def evaluate_many(self, requests: list[HttpRequest]) -> list[Verdict]:
-        if self.reloader.engine is None:
-            raise EngineUnavailable("no compiled ruleset loaded")
-        futures: list[Future] = [self.batcher.submit(r) for r in requests]
+    def evaluate_many(
+        self, requests: list[HttpRequest], tenants: list[str | None] | None = None
+    ) -> list[Verdict]:
+        tenants = tenants or [None] * len(requests)
+        futures: list[Future] = [
+            self.batcher.submit(r, tenant=t) for r, t in zip(requests, tenants)
+        ]
         return [f.result(timeout=self.config.request_timeout_s) for f in futures]
 
     def stats(self) -> dict:
         return {
             "batcher": self.batcher.stats.snapshot(),
-            "ruleset_uuid": self.reloader.current_uuid,
-            "reloads": self.reloader.reloads,
-            "failed_reloads": self.reloader.failed_reloads,
+            "tenants": self.tenants.stats(),
+            "reloads": self.tenants.total_reloads,
+            "failed_reloads": self.tenants.total_failed_reloads,
             "ready": self.ready(),
             "failure_policy": self.config.failure_policy,
         }
@@ -379,7 +412,7 @@ class TpuEngineSidecar:
 
     def start(self) -> None:
         self.batcher.start()
-        self.reloader.start()
+        self.tenants.start()
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, name="sidecar-http", daemon=True
         )
@@ -400,7 +433,7 @@ class TpuEngineSidecar:
             self._serve_thread.join(timeout=10)
         self._httpd.server_close()
         self.batcher.stop()
-        self.reloader.stop()
+        self.tenants.stop()
         if self.audit is not None:
             self.audit.close()
         log.info("tpu-engine sidecar stopped")

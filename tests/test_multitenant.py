@@ -1,0 +1,166 @@
+"""Multi-tenant sidecar tests: N resident rulesets, routing, hot reload.
+
+BASELINE config #5 analog: many namespaced RuleSets resident in one
+sidecar, each hot-reloading independently, with per-request tenant
+routing (X-Waf-Tenant header / bulk "tenant" field).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+from coraza_kubernetes_operator_tpu.sidecar.tenants import TenantManager
+
+RULES_A = 'SecRuleEngine On\nSecRule ARGS "@contains alpha-attack" "id:100,phase:2,deny,status:403"\n'
+RULES_B = 'SecRuleEngine On\nSecRule ARGS "@contains beta-attack" "id:200,phase:2,deny,status:403"\n'
+
+
+@pytest.fixture()
+def stack():
+    cache = RuleSetCache()
+    cache.put("ns-a/rs", RULES_A)
+    cache.put("ns-b/rs", RULES_B)
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    side = TpuEngineSidecar(
+        SidecarConfig(
+            cache_base_url=f"http://127.0.0.1:{srv.port}",
+            instance_key="ns-a/rs, ns-b/rs",
+            poll_interval_s=0.1,
+            host="127.0.0.1",
+            port=0,
+            max_batch_delay_ms=0.5,
+            trust_tenant_header=True,  # tests model a trusted fronting proxy
+        )
+    )
+    side.start()
+    deadline = time.time() + 60
+    while time.time() < deadline and not (
+        side.tenants.engine_for("ns-a/rs") and side.tenants.engine_for("ns-b/rs")
+    ):
+        time.sleep(0.05)
+    yield cache, srv, side
+    side.stop()
+    srv.stop()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_tenant_routing_filter_mode(stack):
+    _cache, _srv, side = stack
+    # default tenant = first key (ns-a)
+    assert _get(side.port, "/?q=alpha-attack")[0] == 403
+    assert _get(side.port, "/?q=beta-attack")[0] == 200  # not ns-a's rule
+    # routed to ns-b via header
+    hdr = {"X-Waf-Tenant": "ns-b/rs"}
+    assert _get(side.port, "/?q=beta-attack", hdr)[0] == 403
+    assert _get(side.port, "/?q=alpha-attack", hdr)[0] == 200
+
+
+def test_unknown_tenant_follows_failure_policy(stack):
+    _cache, _srv, side = stack
+    code, _ = _get(side.port, "/?q=x", {"X-Waf-Tenant": "nope/rs"})
+    assert code == 503  # fail-closed default
+
+
+def test_tenant_header_ignored_unless_trusted(stack):
+    """Filter mode must not let the client pick a lenient tenant (WAF
+    bypass) unless the operator opted in to a trusted fronting proxy."""
+    _cache, _srv, side = stack
+    side.config.trust_tenant_header = False
+    try:
+        # header ignored: evaluated under the default tenant's rules
+        code, _ = _get(side.port, "/?q=alpha-attack", {"X-Waf-Tenant": "ns-b/rs"})
+        assert code == 403
+    finally:
+        side.config.trust_tenant_header = True
+
+
+def test_bulk_mixed_tenants(stack):
+    _cache, _srv, side = stack
+    payload = json.dumps(
+        {
+            "requests": [
+                {"uri": "/?q=alpha-attack", "tenant": "ns-a/rs"},
+                {"uri": "/?q=beta-attack", "tenant": "ns-b/rs"},
+                {"uri": "/?q=alpha-attack", "tenant": "ns-b/rs"},
+                {"uri": "/?q=clean"},
+            ]
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{side.port}/waf/v1/evaluate", data=payload,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        verdicts = json.loads(r.read())["verdicts"]
+    assert [v["interrupted"] for v in verdicts] == [True, True, False, False]
+    assert verdicts[0]["rule_id"] == 100
+    assert verdicts[1]["rule_id"] == 200
+
+
+def test_independent_hot_reload(stack):
+    cache, _srv, side = stack
+    cache.put("ns-b/rs", RULES_B.replace("beta-attack", "gamma-attack"))
+    hdr = {"X-Waf-Tenant": "ns-b/rs"}
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if (
+            _get(side.port, "/?q=gamma-attack", hdr)[0] == 403
+            and _get(side.port, "/?q=beta-attack", hdr)[0] == 200
+        ):
+            break
+        time.sleep(0.1)
+    assert _get(side.port, "/?q=gamma-attack", hdr)[0] == 403
+    # ns-a untouched by ns-b's reload
+    assert _get(side.port, "/?q=alpha-attack")[0] == 403
+    stats = side.tenants.stats()
+    assert stats["ns-b/rs"]["reloads"] >= 2
+    assert stats["ns-a/rs"]["reloads"] == 1
+
+
+def test_many_tenants_resident():
+    """32 tenants resident at once, each routed correctly (BASELINE #5)."""
+    cache = RuleSetCache()
+    keys = []
+    for i in range(32):
+        key = f"ns{i}/rs"
+        keys.append(key)
+        cache.put(
+            key,
+            f'SecRuleEngine On\nSecRule ARGS "@contains attack-{i}-x" '
+            f'"id:{1000 + i},phase:2,deny,status:403"\n',
+        )
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        mgr = TenantManager(
+            cache_base_url=f"http://127.0.0.1:{srv.port}",
+            tenant_keys=keys,
+            poll_interval_s=3600,  # manual polling below
+        )
+        assert mgr.poll_all_once() == 32
+        assert len(mgr.tenants) == 32
+        from coraza_kubernetes_operator_tpu.engine import HttpRequest
+
+        for i in (0, 7, 31):
+            eng = mgr.engine_for(f"ns{i}/rs")
+            v = eng.evaluate_one(HttpRequest(uri=f"/?q=attack-{i}-x"))
+            assert v.interrupted and v.rule_id == 1000 + i
+            v2 = eng.evaluate_one(HttpRequest(uri=f"/?q=attack-{(i+1) % 32}-x"))
+            assert not v2.interrupted
+    finally:
+        srv.stop()

@@ -1,0 +1,193 @@
+"""Differential tests: the C++ host runtime vs the Python reference path.
+
+The native library must produce bit-for-bit identical tensors to
+``engine/request.py`` + ``engine/waf.py:_tensorize`` on the same requests —
+randomized corpora over every transform family, arg shapes, JSON bodies,
+cookies, and selector-regex kinds. Skipped when the library is not built
+(`make native`).
+"""
+
+import random
+import string
+
+import numpy as np
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+from coraza_kubernetes_operator_tpu.compiler.transforms_host import (
+    TRANSFORMS,
+    apply_pipeline,
+)
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.native import (
+    NativeTensorizer,
+    load_library,
+)
+
+pytestmark = pytest.mark.skipif(
+    load_library() is None, reason="native library not built"
+)
+
+RULES = r"""
+SecRuleEngine On
+SecRequestBodyAccess On
+SecRule ARGS|REQUEST_URI "@rx (?i:\bunion\b.{0,40}\bselect\b)" \
+  "id:1,phase:2,deny,status:403,t:none,t:urlDecodeUni,t:lowercase"
+SecRule ARGS_NAMES|ARGS "@contains evil" "id:2,phase:2,deny,status:403,t:none,t:htmlEntityDecode"
+SecRule REQUEST_HEADERS:User-Agent "@pm sqlmap nikto" "id:3,phase:1,deny,status:403,t:lowercase"
+SecRule REQUEST_HEADERS:'/^X-Custom-.*/' "@contains inject" "id:4,phase:1,deny,status:403"
+SecRule REQUEST_COOKIES "@rx session=admin" "id:5,phase:1,deny,status:403,t:normalizePath"
+SecRule REQUEST_BODY "@contains attack" "id:6,phase:2,deny,status:403,t:base64Decode"
+SecRule ARGS "@rx select" "id:7,phase:2,pass,t:cmdLine,setvar:'tx.score=+2'"
+SecRule TX:score "@ge 4" "id:8,phase:2,deny,status:403"
+SecRule &ARGS "@gt 8" "id:9,phase:2,deny,status:403"
+SecRule REQUEST_URI "@contains ../" "id:10,phase:1,deny,status:403,t:none,t:removeComments,t:jsDecode,t:cssDecode"
+SecRule QUERY_STRING "@contains x" "id:11,phase:1,pass,t:compressWhitespace,t:trim,t:removeWhitespace"
+SecRule REQUEST_LINE "@contains probe" "id:12,phase:1,deny,status:403,t:hexDecode"
+"""
+
+
+def _random_requests(n: int, seed: int) -> list[HttpRequest]:
+    rng = random.Random(seed)
+    alphabet = string.printable + "\x00\xe9\xff%&=+;"
+    reqs = []
+    for i in range(n):
+        kind = rng.randrange(6)
+        headers = [("Host", "test.local"), ("User-Agent", rng.choice(
+            ["Mozilla/5.0", "sqlmap/1.7", "curl/8", "NIKTO scan"]))]
+        body = b""
+        uri = "/"
+        method = rng.choice(["GET", "POST", "PUT"])
+        if kind == 0:
+            q = "&".join(
+                f"{''.join(rng.choices(alphabet, k=rng.randrange(1, 8)))}="
+                f"{''.join(rng.choices(alphabet, k=rng.randrange(0, 40)))}"
+                for _ in range(rng.randrange(0, 6))
+            )
+            uri = f"/p?{q}"
+        elif kind == 1:
+            uri = "/?q=union+%73elect+a+from+b&r=%u0041%3Cscript"
+            headers.append(("X-Custom-Probe", "try to inject here"))
+        elif kind == 2:
+            body = "&".join(
+                f"k{j}={''.join(rng.choices(alphabet, k=rng.randrange(0, 60)))}"
+                for j in range(rng.randrange(1, 5))
+            ).encode("latin-1", "replace")
+            headers.append(("Content-Type", "application/x-www-form-urlencoded"))
+        elif kind == 3:
+            body = (
+                b'{"user": {"name": "bob\\u00e9", "ids": [1, 2.5, true, null],'
+                b' "note": "eviltext /* c */"}, "n": 1e30, "b": -0.125}'
+            )
+            headers.append(("Content-Type", "application/json"))
+        elif kind == 4:
+            body = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 80)))
+            headers.append(("Content-Type", "application/json"))  # invalid json
+        else:
+            headers.append(("Cookie", " session=admin; a = b;theme=dark "))
+            uri = "/a/../b/./c%2e%2e/"
+        reqs.append(
+            HttpRequest(
+                method=method, uri=uri, version="HTTP/1.1",
+                headers=headers, body=body, remote_addr="10.1.2.3",
+            )
+        )
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WafEngine(RULES)
+
+
+def test_native_available(engine):
+    assert engine.native_enabled
+
+
+def test_differential_tensorize(engine):
+    for seed in (1, 2, 3):
+        requests = _random_requests(64, seed)
+        extractions = [engine.extractor.extract(r) for r in requests]
+        py = engine._tensorize(extractions)
+        nat = engine._native.tensorize(requests)
+        names = [
+            "data", "lengths", "kind1", "kind2", "kind3", "req_id",
+            "numvals", "vdata", "vlengths",
+        ]
+        for name, a, b in zip(names, py, nat):
+            a = np.asarray(a)
+            b = np.asarray(b)
+            assert a.shape == b.shape, (seed, name, a.shape, b.shape)
+            assert (a == b).all(), (
+                seed, name, np.argwhere(a != b)[:5],
+            )
+
+
+def test_differential_verdicts(engine):
+    requests = _random_requests(128, 7)
+    native_verdicts = engine.evaluate(requests)  # native path
+    # force python path
+    avail, engine._native._ctx = engine._native._ctx, None
+    try:
+        py_verdicts = engine.evaluate(requests)
+    finally:
+        engine._native._ctx = avail
+    for i, (a, b) in enumerate(zip(native_verdicts, py_verdicts)):
+        assert (a.interrupted, a.status, a.rule_id, a.matched_ids) == (
+            b.interrupted, b.status, b.rule_id, b.matched_ids
+        ), (i, requests[i].uri)
+
+
+def test_transform_parity_exhaustive():
+    """Every native transform opcode agrees with its Python reference on
+    adversarial byte strings."""
+    from coraza_kubernetes_operator_tpu.native import _OPCODES
+
+    rng = random.Random(42)
+    cases = [
+        b"", b"a", b"%41%zz%", b"%u0041%u00e9%U1F600x", b"+a+b%2",
+        b"&#65;&#x41;&amp;&unknown;&#xZZ;&#1114112;", b"a\x00b\x00",
+        b"  a  b\t\nc  ", b"/a/../../b/./c/", b"a\\x41\\u0042\\101\\8\\",
+        b"\\41 x\\000041y\\g", b"SGVsbG8gV29ybGQ=!after", b"@@SGVsbG8=",
+        b"48656c6c6fzz21", b"/* c */ x -- y\n z # w\n<!-- h --> t",
+        b"a,b;c\\d\"e'f^g / (h", b"\xc3\xa9\xe2\x82\xac\xf0\x9f\x98\x80\xff\xfe",
+        b"caf\xe9 \x80\xc2", bytes(range(256)),
+    ]
+    for _ in range(200):
+        cases.append(bytes(rng.randrange(256) for _ in range(rng.randrange(0, 50))))
+
+    # build one engine per... cheaper: use a tiny ctx-free check through a
+    # synthetic ruleset exercising each transform as its own host pipeline is
+    # heavy; instead compare via ctypes on a throwaway context is not exposed.
+    # The pipeline-level differential below covers compositions; here we
+    # check single ops through a minimal one-rule engine per transform.
+    name_by_op = {}
+    for name, op in _OPCODES.items():
+        name_by_op.setdefault(op, name)
+    for name in name_by_op.values():
+        if name in ("none",):
+            continue
+        rules = (
+            "SecRuleEngine On\nSecRequestBodyAccess On\n"
+            f'SecRule ARGS "@contains zzneverzz" "id:1,phase:2,deny,status:403,t:{name}"\n'
+        )
+        try:
+            eng = WafEngine(rules)
+        except Exception:
+            continue  # transform not accepted in seclang position
+        if not eng.native_enabled:
+            continue
+        host = eng.compiled.host_pipelines()
+        if not host:
+            continue  # compiled to a device pipeline; covered elsewhere
+        names = list(host[0][1])
+        for case in cases:
+            req = HttpRequest(
+                uri="/?k=" + "".join("%%%02x" % b for b in case)
+            )
+            nat = eng._native.tensorize([req])
+            extr = [eng.extractor.extract(req)]
+            py = eng._tensorize(extr)
+            assert (np.asarray(py[7]) == np.asarray(nat[7])).all(), (
+                name, case, apply_pipeline(case, names),
+            )
