@@ -25,8 +25,16 @@ docker.build:
 # -- tests --------------------------------------------------------------------
 
 .PHONY: test test.unit
-test test.unit:  ## Unit + kernel + controller tests on the virtual CPU mesh.
+test test.unit:  ## Fast tier: unit + kernel + controller tests on the virtual CPU mesh.
 	$(PYTHON) -m pytest tests/ -x -q
+
+.PHONY: test.slow
+test.slow:  ## Nightly tier: full mesh-shape matrix and large-shape kernel cases.
+	$(PYTHON) -m pytest tests/ -x -q -m slow
+
+.PHONY: test.all
+test.all:  ## Both tiers in one run.
+	$(PYTHON) -m pytest tests/ -x -q -m ""
 
 .PHONY: test.integration
 test.integration:  ## In-process integration scenarios (cache+sidecar+controllers).

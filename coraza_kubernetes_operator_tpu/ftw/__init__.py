@@ -11,7 +11,14 @@ shape), a replayer that drives either an in-process ``WafEngine`` or a
 live tpu-engine sidecar over HTTP, and the same ignore-ledger semantics.
 """
 
-from .loader import FtwStage, FtwTest, load_overrides, load_test_file, load_tests
+from .loader import (
+    FtwStage,
+    FtwTest,
+    load_overrides,
+    load_test_file,
+    load_tests,
+    load_tests_report,
+)
 from .runner import FtwResult, FtwRunner, run_corpus
 
 __all__ = [
@@ -22,5 +29,6 @@ __all__ = [
     "load_overrides",
     "load_test_file",
     "load_tests",
+    "load_tests_report",
     "run_corpus",
 ]

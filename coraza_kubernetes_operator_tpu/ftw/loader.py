@@ -19,6 +19,10 @@ from pathlib import Path
 
 import yaml
 
+from ..utils import get_logger
+
+log = get_logger("ftw.loader")
+
 
 @dataclass
 class FtwStage:
@@ -130,21 +134,30 @@ def load_test_file(path: str | Path) -> list[FtwTest]:
     return tests
 
 
-def load_tests(root: str | Path) -> list[FtwTest]:
-    """Recursively load every go-ftw test file under ``root``. Files that
-    are not ftw test files (no ``tests`` key, or unparsable) are skipped —
-    a stray fixture must not abort the whole conformance run."""
+def load_tests_report(root: str | Path) -> tuple[list[FtwTest], list[str]]:
+    """Recursively load every go-ftw test file under ``root``. Returns
+    ``(tests, skipped_paths)``: files that are not ftw test files (no
+    ``tests`` key, or unparsable) are skipped — a stray fixture must not
+    abort the whole conformance run — but each skip is logged and reported
+    so a corrupted file can't silently shrink the corpus."""
     root = Path(root)
     tests: list[FtwTest] = []
+    skipped: list[str] = []
     paths = sorted(root.rglob("*.yaml")) + sorted(root.rglob("*.yml"))
     for path in paths:
         if path.name == "ftw.yml":
             continue
         try:
             tests.extend(load_test_file(path))
-        except (FtwFormatError, yaml.YAMLError):
-            continue
-    return tests
+        except (FtwFormatError, yaml.YAMLError) as err:
+            skipped.append(str(path))
+            log.error("skipping unparsable ftw test file", err, path=str(path))
+    return tests, skipped
+
+
+def load_tests(root: str | Path) -> list[FtwTest]:
+    """``load_tests_report`` without the skip report."""
+    return load_tests_report(root)[0]
 
 
 def load_overrides(path: str | Path) -> dict[str, str]:

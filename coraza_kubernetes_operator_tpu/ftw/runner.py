@@ -29,7 +29,7 @@ from ..engine.request import HttpRequest
 from ..engine.waf import WafEngine
 from ..observability.audit import AuditLogger, AuditRecord
 from ..utils import get_logger
-from .loader import FtwStage, FtwTest, load_overrides, load_tests
+from .loader import FtwStage, FtwTest, load_overrides, load_tests_report
 
 log = get_logger("ftw.runner")
 
@@ -45,16 +45,21 @@ class FtwResult:
     passed: list[str] = field(default_factory=list)
     failed: dict[str, str] = field(default_factory=dict)  # title -> reason
     ignored: dict[str, str] = field(default_factory=dict)  # title -> ledger reason
+    skipped_files: list[str] = field(default_factory=list)  # unparsable corpus files
 
     @property
     def ok(self) -> bool:
-        return not self.failed
+        # A run with zero executed tests (or any unparsable corpus file) is
+        # not green: a fully-corrupted corpus must not gate CI to pass.
+        ran_any = bool(self.passed or self.failed or self.ignored)
+        return not self.failed and not self.skipped_files and ran_any
 
     def summary(self) -> dict:
         return {
             "passed": len(self.passed),
             "failed": len(self.failed),
             "ignored": len(self.ignored),
+            "skipped_files": len(self.skipped_files),
             "failures": self.failed,
         }
 
@@ -238,4 +243,7 @@ def run_corpus(
     and replay in-process honoring the ledger."""
     overrides = load_overrides(overrides_path) if overrides_path else {}
     runner = FtwRunner(engine=WafEngine(rules), overrides=overrides)
-    return runner.run(load_tests(corpus_dir))
+    tests, skipped = load_tests_report(corpus_dir)
+    result = runner.run(tests)
+    result.skipped_files = skipped
+    return result

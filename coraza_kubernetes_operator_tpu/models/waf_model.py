@@ -399,11 +399,15 @@ def post_match(
     prelim = rules_from_links(link_m)
 
     # 4c: anomaly-score counters + threshold links. f32 matmul (exact for
-    # |weights| < 2^24) — an int32 matmul would not ride the MXU.
+    # |weights| < 2^24) — an int32 matmul would not ride the MXU. Precision
+    # HIGHEST keeps the operands f32 on TPU: the default precision demotes
+    # to bf16 (8 mantissa bits), which silently corrupts any setvar
+    # increment not bf16-representable.
     counters = model.counter_base[None, :] + jnp.dot(
         prelim.astype(jnp.float32),
         model.weights.astype(jnp.float32),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     ).astype(jnp.int32)
     cvals = counters[:, model.lcounter]
     m_counter = _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :]) ^ model.lneg[None, :]

@@ -74,11 +74,12 @@ class SidecarConfig:
     # SecAuditLog /dev/stdout shape), anything else a file path.
     audit_log: str | None = None
     audit_relevant_only: bool = True
-    # Honor X-Waf-Tenant on FILTER-mode requests. Off by default: in filter
-    # mode that header arrives from the (untrusted) client, and selecting a
-    # lenient tenant's ruleset would be a WAF bypass. Enable only when a
-    # trusted proxy in front sets/strips the header. The bulk API (an
-    # internal surface) always honors per-request tenants.
+    # Honor X-Waf-Tenant (filter mode) and per-request/header tenant
+    # selection (bulk mode). Off by default: both surfaces share the same
+    # unauthenticated listener, so tenant selection from request content
+    # would let anyone who can reach the port probe arbitrary tenants'
+    # rulesets (or pick a lenient tenant — a WAF bypass). Enable only when
+    # a trusted proxy in front sets/strips the header.
     trust_tenant_header: bool = False
 
 
@@ -238,12 +239,18 @@ class _Handler(BaseHTTPRequestHandler):
             )
 
     def _handle_bulk(self, body: bytes) -> None:
-        default_tenant = self.headers.get(TENANT_HEADER) or None
+        # Tenant selection (header or per-request field) is gated behind the
+        # same trust_tenant_header switch as filter mode: the bulk API shares
+        # the unauthenticated listener, so without the explicit opt-in a
+        # caller must not be able to probe arbitrary tenants' rulesets.
+        trust = self.sidecar.config.trust_tenant_header
+        default_tenant = (self.headers.get(TENANT_HEADER) or None) if trust else None
         try:
             payload = json.loads(body.decode("utf-8"))
             reqs = [request_from_json(o) for o in payload["requests"]]
             tenants = [
-                o.get("tenant") or default_tenant for o in payload["requests"]
+                (o.get("tenant") or default_tenant) if trust else None
+                for o in payload["requests"]
             ]
         except (ValueError, KeyError, TypeError, AttributeError) as err:
             self._reply_json(400, {"error": f"invalid request payload: {err}"})

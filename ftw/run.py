@@ -43,11 +43,11 @@ def main() -> int:
     from coraza_kubernetes_operator_tpu.ftw import (
         FtwRunner,
         load_overrides,
-        load_tests,
+        load_tests_report,
     )
 
     overrides = load_overrides(args.overrides) if Path(args.overrides).exists() else {}
-    tests = load_tests(args.corpus)
+    tests, skipped_files = load_tests_report(args.corpus)
     if args.mode == "inproc":
         from coraza_kubernetes_operator_tpu.engine import WafEngine
 
@@ -59,6 +59,7 @@ def main() -> int:
         )
 
     result = runner.run(tests)
+    result.skipped_files = skipped_files
     print(json.dumps({"mode": args.mode, "tests": len(tests), **result.summary()}))
     return 0 if result.ok else 1
 
