@@ -39,21 +39,6 @@ from ..cache.server import (
     DEFAULT_CACHE_SERVER_PORT,
     GarbageCollectionConfig,
 )
-from ..controlplane.api_types import (
-    ConfigMap,
-    DriverConfig,
-    Engine,
-    EngineSpec,
-    IstioDriverConfig,
-    IstioWasmConfig,
-    ObjectMeta,
-    RuleSet,
-    RuleSetCacheServerConfig,
-    RuleSetReference,
-    RuleSetSpec,
-    RuleSourceReference,
-    TpuDriverConfig,
-)
 from ..controlplane.manager import ControllerManager
 from ..controlplane.store import ObjectStore
 from ..utils import get_logger
@@ -72,80 +57,9 @@ def parse_duration(text: str) -> timedelta:
 
 # -- manifest loading ---------------------------------------------------------
 
-
-def object_from_manifest(doc: dict):
-    kind = doc.get("kind")
-    meta_doc = doc.get("metadata", {}) or {}
-    meta = ObjectMeta(
-        name=meta_doc.get("name", ""),
-        namespace=meta_doc.get("namespace", "default"),
-        labels=meta_doc.get("labels", {}) or {},
-        annotations=meta_doc.get("annotations", {}) or {},
-    )
-    spec = doc.get("spec", {}) or {}
-    if kind == "ConfigMap":
-        return ConfigMap(metadata=meta, data=doc.get("data", {}) or {})
-    if kind == "RuleSet":
-        return RuleSet(
-            metadata=meta,
-            spec=RuleSetSpec(
-                rules=[
-                    RuleSourceReference(name=r.get("name", ""))
-                    for r in spec.get("rules", [])
-                ]
-            ),
-        )
-    if kind == "Engine":
-        driver_doc = spec.get("driver", {}) or {}
-        driver = DriverConfig()
-        if "istio" in driver_doc:
-            wasm = (driver_doc["istio"] or {}).get("wasm", {}) or {}
-            cache_cfg = wasm.get("ruleSetCacheServer")
-            driver.istio = IstioDriverConfig(
-                wasm=IstioWasmConfig(
-                    image=wasm.get("image", ""),
-                    mode=wasm.get("mode", "gateway"),
-                    workload_selector=wasm.get("workloadSelector"),
-                    rule_set_cache_server=(
-                        RuleSetCacheServerConfig(
-                            poll_interval_seconds=int(
-                                cache_cfg.get("pollIntervalSeconds", 15)
-                            )
-                        )
-                        if cache_cfg
-                        else None
-                    ),
-                )
-            )
-        if "tpu" in driver_doc:
-            tpu = driver_doc["tpu"] or {}
-            cache_cfg = tpu.get("ruleSetCacheServer")
-            driver.tpu = TpuDriverConfig(
-                image=tpu.get("image", TpuDriverConfig.image),
-                replicas=int(tpu.get("replicas", 1)),
-                max_batch_size=int(tpu.get("maxBatchSize", 2048)),
-                max_batch_delay_ms=int(tpu.get("maxBatchDelayMs", 2)),
-                rule_set_cache_server=(
-                    RuleSetCacheServerConfig(
-                        poll_interval_seconds=int(
-                            cache_cfg.get("pollIntervalSeconds", 15)
-                        )
-                    )
-                    if cache_cfg
-                    else None
-                ),
-            )
-        return Engine(
-            metadata=meta,
-            spec=EngineSpec(
-                rule_set=RuleSetReference(
-                    name=(spec.get("ruleSet", {}) or {}).get("name", "")
-                ),
-                driver=driver,
-                failure_policy=spec.get("failurePolicy", "fail"),
-            ),
-        )
-    return None  # kinds we do not manage (Gateways etc.) are skipped
+# Object <-> manifest conversion is shared with the Kubernetes API source
+# (controlplane/manifests.py): one codec, both transports.
+from ..controlplane.manifests import object_from_manifest  # noqa: E402
 
 
 class ManifestSource:

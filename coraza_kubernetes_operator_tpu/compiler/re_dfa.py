@@ -75,6 +75,10 @@ class DFA:
     match_end: np.ndarray  # [S] bool
     classmap: np.ndarray  # [256] int32
     always_match: bool
+    # Source AST (host-only metadata): lets the model builder try the
+    # conv-segment decomposition (``compiler/segments.py``) before falling
+    # back to scanning these tables.
+    ast: object = None
 
     @property
     def n_states(self) -> int:
@@ -117,7 +121,7 @@ def _byte_classes(nfa: PositionNFA) -> tuple[np.ndarray, list[int]]:
     return classmap, reps
 
 
-def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192) -> DFA:
+def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object = None) -> DFA:
     classmap, reps = _byte_classes(nfa)
     n_classes = len(reps)
 
@@ -177,6 +181,7 @@ def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192) -> DFA:
         match_end=np.asarray(end_rows, dtype=bool),
         classmap=classmap,
         always_match=nfa.always_matches,
+        ast=ast,
     )
 
 
@@ -186,7 +191,7 @@ def compile_regex_dfa(
     """Compile an RE2-subset pattern into scanner tables (search semantics)."""
     ast = parse_regex(pattern, case_insensitive=case_insensitive)
     nfa = build_position_nfa(ast)
-    return compile_nfa_dfa(nfa, max_states=max_states)
+    return compile_nfa_dfa(nfa, max_states=max_states, ast=ast)
 
 
 def _literal_ast(literal: bytes, case_insensitive: bool) -> object:
@@ -220,7 +225,7 @@ def literal_dfa(
     elif ends_with:
         ast = RCat([ast, RAssert("end")])
     nfa = build_position_nfa(ast)
-    return compile_nfa_dfa(nfa)
+    return compile_nfa_dfa(nfa, ast=ast)
 
 
 def pm_dfa(words: list[bytes], max_states: int = 65536) -> DFA:
@@ -232,4 +237,4 @@ def pm_dfa(words: list[bytes], max_states: int = 65536) -> DFA:
         raise DFAError("@pm requires at least one pattern")
     ast = RAlt(branches) if len(branches) > 1 else branches[0]
     nfa = build_position_nfa(ast)
-    return compile_nfa_dfa(nfa, max_states=max_states)
+    return compile_nfa_dfa(nfa, max_states=max_states, ast=ast)

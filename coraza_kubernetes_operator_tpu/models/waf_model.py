@@ -38,7 +38,9 @@ from ..compiler.ruleset import (
     LINK_NUMERIC,
     LINK_STRING,
 )
+from ..compiler.segments import plan_segments
 from ..ops.dfa import DFABank, stack_dfas
+from ..ops.segment import SegmentBlock, build_segment_block, match_segment_block
 from ..ops.transforms import apply_device_pipeline
 
 _BIG = jnp.int32(2**31 - 1)
@@ -54,6 +56,10 @@ class WafModel:
     """Pytree of device arrays + static metadata (hashable aux)."""
 
     banks: list[DFABank]
+    # Conv-segment tier: groups whose regex decomposes exactly into
+    # fixed-length segments + gaps match here (one MXU conv for all
+    # positions, ``ops/segment.py``); only the rest scan DFA banks.
+    segs: list[SegmentBlock]
     # link arrays [Rl]
     ltype: jnp.ndarray
     lneg: jnp.ndarray
@@ -81,6 +87,7 @@ class WafModel:
     counter_base: jnp.ndarray  # [C]
     # static metadata
     bank_pipelines: tuple = field(default_factory=tuple)  # pipeline id per bank
+    seg_pipelines: tuple = field(default_factory=tuple)  # pipeline id per seg block
     pipelines: tuple = field(default_factory=tuple)  # names per pipeline id
     pipeline_device: tuple = field(default_factory=tuple)
     host_variant_index: tuple = field(default_factory=tuple)  # pid -> variant slot (-1 device)
@@ -90,6 +97,7 @@ class WafModel:
     def tree_flatten(self):
         leaves = (
             self.banks,
+            self.segs,
             self.ltype,
             self.lneg,
             self.lgroup,
@@ -113,6 +121,7 @@ class WafModel:
         )
         aux = (
             self.bank_pipelines,
+            self.seg_pipelines,
             self.pipelines,
             self.pipeline_device,
             self.host_variant_index,
@@ -145,18 +154,39 @@ def lgroup_onehot(lgroup: np.ndarray, n_groups: int) -> np.ndarray:
 
 def build_model(crs: CompiledRuleSet) -> WafModel:
     """Lay out a CompiledRuleSet as device arrays. Groups are re-ordered so
-    each bank's groups are contiguous; links are rewritten accordingly."""
-    # Bucket groups: (pipeline_id, state_bucket) → [group ids]
+    each bank's groups are contiguous; links are rewritten accordingly.
+
+    Routing: each group first tries the exact conv-segment decomposition
+    (``compiler/segments.py``) — those match on the MXU conv tier; the
+    rest bucket into DFA banks by state count. Global group order (and the
+    lgroup remap) is: segment blocks sorted by pipeline id, then DFA
+    buckets sorted by (pipeline, bucket)."""
+    seg_groups: dict[int, list[tuple[int, object]]] = {}
     buckets: dict[tuple[int, int], list[int]] = {}
     for gid, grp in enumerate(crs.groups):
+        pid = crs.group_pipeline[gid]
+        plan = plan_segments(grp.dfa.ast)
+        if plan is not None:
+            seg_groups.setdefault(pid, []).append((gid, plan))
+            continue
         s = grp.dfa.n_states
         bucket = next(b for b in _STATE_BUCKETS if s <= b)
-        buckets.setdefault((crs.group_pipeline[gid], bucket), []).append(gid)
+        buckets.setdefault((pid, bucket), []).append(gid)
+
+    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
+    next_new = 0
+    segs: list[SegmentBlock] = []
+    seg_pipelines: list[int] = []
+    for pid in sorted(seg_groups):
+        items = seg_groups[pid]
+        segs.append(build_segment_block([plan for _, plan in items]))
+        seg_pipelines.append(pid)
+        for g, _ in items:
+            remap[g] = next_new
+            next_new += 1
 
     banks: list[DFABank] = []
     bank_pipelines: list[int] = []
-    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
-    next_new = 0
     for (pid, _bucket), gids in sorted(buckets.items()):
         banks.append(stack_dfas([crs.groups[g].dfa for g in gids]))
         bank_pipelines.append(pid)
@@ -233,6 +263,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
 
     return WafModel(
         banks=banks,
+        segs=segs,
         ltype=jnp.asarray(ltype),
         lneg=jnp.asarray(lneg),
         lgroup=jnp.asarray(lgroup),
@@ -256,6 +287,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
             crs.counter_base if crs.counter_base.size else np.zeros(1, np.int32)
         ),
         bank_pipelines=tuple(bank_pipelines),
+        seg_pipelines=tuple(seg_pipelines),
         pipelines=tuple(tuple(p) for p in crs.pipelines),
         pipeline_device=tuple(crs.pipeline_device),
         host_variant_index=tuple(host_variant_index),
@@ -290,12 +322,14 @@ def eval_waf(
     """Evaluate one batch. Returns a dict of per-request verdict arrays."""
     b = numvals.shape[0]
 
-    # 1+2: transforms + DFA bank scans → per-target group hits.
-    per_bank: list[jnp.ndarray] = []
+    # 1+2: transforms + matchers → per-target group hits. Segment blocks
+    # first, DFA banks after — the same global order build_model's remap
+    # assigned.
+    per_block: list[jnp.ndarray] = []
     transformed: dict[int, tuple[jnp.ndarray, jnp.ndarray]] = {}
     from ..ops.dfa import scan_dfa_bank
 
-    for bank, pid in zip(model.banks, model.bank_pipelines):
+    def transformed_for(pid: int) -> tuple[jnp.ndarray, jnp.ndarray]:
         if pid not in transformed:
             slot = model.host_variant_index[pid]
             if slot >= 0:
@@ -304,10 +338,16 @@ def eval_waf(
                 transformed[pid] = apply_device_pipeline(
                     data, lengths, model.pipelines[pid]
                 )
-        tdata, tlen = transformed[pid]
-        per_bank.append(scan_dfa_bank(bank, tdata, tlen))
-    if per_bank:
-        group_hits = jnp.concatenate(per_bank, axis=1)  # [T, G]
+        return transformed[pid]
+
+    for seg, pid in zip(model.segs, model.seg_pipelines):
+        tdata, tlen = transformed_for(pid)
+        per_block.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
+    for bank, pid in zip(model.banks, model.bank_pipelines):
+        tdata, tlen = transformed_for(pid)
+        per_block.append(scan_dfa_bank(bank, tdata, tlen))
+    if per_block:
+        group_hits = jnp.concatenate(per_block, axis=1)  # [T, G]
     else:
         group_hits = jnp.zeros((data.shape[0], 1), dtype=bool)
 
@@ -333,12 +373,16 @@ def post_match(
     k = model.inc.shape[0]
 
     # 3: incidence + per-target link matches. All the T-sized lookups are
-    # one-hot int8 matmuls: XLA's gather lowering serializes on TPU while
-    # these contractions ride the MXU (measured ~100x on the same shapes).
+    # one-hot matmuls: XLA's gather lowering serializes on TPU while these
+    # contractions ride the MXU (measured ~100x on the same shapes). The
+    # one-hot operands are cast to bf16 (0/1 and tiny counts — exact):
+    # XLA lowers int8 DotGeneral off the MXU on TPU, bf16 is the native
+    # systolic dtype.
     gm = (
         jnp.dot(
-            group_hits.astype(jnp.int8), model.e_lg,
-            preferred_element_type=jnp.int32,
+            group_hits.astype(jnp.bfloat16),
+            model.e_lg.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
         )
         > 0
     )  # [T, Rl] == group_hits[:, lgroup]
@@ -347,13 +391,21 @@ def post_match(
         (kind1[:, None] == kinds_iota)
         | (kind2[:, None] == kinds_iota)
         | (kind3[:, None] == kinds_iota)
-    ).astype(jnp.int8)  # [T, K]
+    ).astype(jnp.bfloat16)  # [T, K]
     rel = (
-        jnp.dot(k_multi, model.inc.astype(jnp.int8), preferred_element_type=jnp.int32)
+        jnp.dot(
+            k_multi,
+            model.inc.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
         > 0
     )
     excl = (
-        jnp.dot(k_multi, model.exc.astype(jnp.int8), preferred_element_type=jnp.int32)
+        jnp.dot(
+            k_multi,
+            model.exc.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
         > 0
     )
     str_t = rel & ~excl & (gm ^ model.lneg[None, :])  # [T, Rl]
@@ -362,13 +414,15 @@ def post_match(
     # serialize on TPU while this contraction rides the MXU (it also avoids
     # an XLA:CPU miscompile where scatter-max over a fused gather operand
     # read zeros). Padding rows carry req_id == B and select no column.
+    # bf16 is exact: the contraction sums at most a few one-hot products
+    # per output (#targets per request << 256).
     onehot = (req_id[:, None] == jnp.arange(b, dtype=req_id.dtype)[None, :])  # [T, B]
     m_str = (
         jnp.einsum(
             "tb,tr->br",
-            onehot.astype(jnp.int8),
-            str_t.astype(jnp.int8),
-            preferred_element_type=jnp.int32,
+            onehot.astype(jnp.bfloat16),
+            str_t.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
         )
         > 0
     )  # [B, Rl]
@@ -391,9 +445,12 @@ def post_match(
         # AND over a rule's links == "every selected link matched", computed
         # as a multiplicity-count matmul (MXU) instead of a [B, Rr, MX]
         # gather: count of matched links must equal the rule's link count.
+        # bf16 exact: counts <= MX (a rule's link count) << 256.
         counts = jnp.dot(
-            lm.astype(jnp.int8), model.m_count, preferred_element_type=jnp.int32
-        )  # [B, Rr]
+            lm.astype(jnp.bfloat16),
+            model.m_count.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)  # [B, Rr]
         return counts == model.link_count[None, :]
 
     prelim = rules_from_links(link_m)

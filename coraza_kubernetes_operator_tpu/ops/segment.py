@@ -1,0 +1,449 @@
+"""Conv-segment matcher: every match position of every segment in ONE
+MXU convolution, then gap-chaining as bitmap algebra.
+
+Where the DFA bank (``ops/dfa.py``) spends ``256·S·G`` MACs *per input
+byte* (a sequential ``lax.scan``), this tier matches all fixed-length
+byte-class segments (``compiler/segments.py``) for **all start positions
+at once**:
+
+1. **embed**: bytes → ``[T, Lp, C]`` channel planes built from pure VPU
+   comparisons (nibble one-hots, class-interval tests, a constant ones
+   plane) — no gathers, no 256-wide one-hot.
+2. **conv**: one ``conv_general_dilated`` with kernel ``[W, C, N]``. Each
+   segment position contributes exactly 2 when its byte matches (hi+lo
+   nibble hits for product classes, weight-2 indicator otherwise, the
+   ones plane for padding), so ``out == 2W`` ⇔ the segment matches at
+   that window start. This is the classic exact-match-as-threshold
+   formulation: a DFA transition needs a table lookup; an equality test
+   is just arithmetic, and arithmetic is what the systolic array does.
+3. **chain**: per-branch gap constraints via shifts, prefix sums
+   (bounded/unbounded any-gaps) and an associative latch scan
+   (single-class gaps like ``\\s*`` / ``[^>]*``) on ``[T, Q]`` bitmaps.
+
+Position space: padded index ``p`` covers a front NUL pad (``p = 0``,
+which makes start-of-input read as a non-word byte for ``\\b``) plus the
+buffer; chain bitmaps say "the next element may start at ``p``". Match
+validity is enforced per segment (``p + n_real <= 1 + len``) and at the
+final reduce, so gap travel through the zero tail can never fabricate a
+match.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.re_parser import ALL_BYTES
+from ..compiler.segments import Branch, Gap, Seg, SegmentPlan
+
+# ---------------------------------------------------------------------------
+# Host-side build: plans → channel/kernel spec
+# ---------------------------------------------------------------------------
+
+
+def _intervals(mask: int) -> list[tuple[int, int]]:
+    """Byte mask → sorted inclusive intervals."""
+    out: list[tuple[int, int]] = []
+    b = 0
+    while b < 256:
+        if mask >> b & 1:
+            start = b
+            while b < 256 and mask >> b & 1:
+                b += 1
+            out.append((start, b - 1))
+        else:
+            b += 1
+    return out
+
+
+def _product_parts(mask: int) -> tuple[tuple[int, ...], tuple[int, ...]] | None:
+    """If ``mask`` is exactly ``hiSet x loSet``, return the nibble sets."""
+    his: set[int] = set()
+    los: set[int] = set()
+    count = 0
+    for byte in range(256):
+        if mask >> byte & 1:
+            his.add(byte >> 4)
+            los.add(byte & 15)
+            count += 1
+    if count and len(his) * len(los) == count:
+        return tuple(sorted(his)), tuple(sorted(los))
+    return None
+
+
+@dataclass(frozen=True)
+class SegmentSpec:
+    """Hashable static program for one pipeline's conv block."""
+
+    w: int  # kernel width
+    n_seg: int  # conv output channels
+    channels: tuple  # embed plan: ('hi',k)|('lo',k)|('one',)|('ind', intervals)
+    # per conv channel: (n_lead, n_real)
+    seg_meta: tuple[tuple[int, int], ...]
+    # per branch: (group, chan_elements) where chan_elements is a tuple of
+    #   ('seg', chan) | ('gapany', lo, hi|-1) | ('gapcls', intervals, lo, hi|-1)
+    # plus anchors
+    branches: tuple[tuple[int, tuple, bool, bool], ...]
+    always: tuple[int, ...]  # group ids that always match
+    n_groups: int
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SegmentBlock:
+    """Device arrays + static spec for one pipeline's conv matcher."""
+
+    kernel: jnp.ndarray  # [W, C, N] bf16
+    spec: SegmentSpec
+
+    def tree_flatten(self):
+        return (self.kernel,), self.spec
+
+    @classmethod
+    def tree_unflatten(cls, spec, children):
+        return cls(children[0], spec)
+
+    @property
+    def n_groups(self) -> int:
+        return self.spec.n_groups
+
+
+def build_segment_block(plans: list[SegmentPlan]) -> SegmentBlock:
+    """Stack group plans (group id = list index) into one conv block."""
+    channels: list[tuple] = [("hi", k) for k in range(16)]
+    channels += [("lo", k) for k in range(16)]
+    channels.append(("one",))
+    ch_index: dict[tuple, int] = {c: i for i, c in enumerate(channels)}
+
+    def indicator(mask: int) -> int:
+        key = ("ind", tuple(_intervals(mask)))
+        if key not in ch_index:
+            ch_index[key] = len(channels)
+            channels.append(key)
+        return ch_index[key]
+
+    # Intern segments; collect branch programs.
+    seg_ids: dict[tuple[int, ...], int] = {}
+    seg_meta: list[tuple[int, int]] = []
+    seg_classes: list[tuple[int, ...]] = []
+    branches: list[tuple[int, tuple, bool, bool]] = []
+    always: list[int] = []
+    w = 1
+    for gid, plan in enumerate(plans):
+        if plan.always:
+            always.append(gid)
+        for br in plan.branches:
+            prog: list[tuple] = []
+            for el in br.elements:
+                if isinstance(el, Seg):
+                    key = el.classes
+                    if key not in seg_ids:
+                        seg_ids[key] = len(seg_classes)
+                        seg_classes.append(key)
+                        seg_meta.append((el.n_lead, el.n_real))
+                        w = max(w, len(key))
+                    prog.append(("seg", seg_ids[key]))
+                else:
+                    hi = -1 if el.hi is None else el.hi
+                    if el.mask == ALL_BYTES:
+                        prog.append(("gapany", el.lo, hi))
+                    else:
+                        prog.append(
+                            ("gapcls", tuple(_intervals(el.mask)), el.lo, hi)
+                        )
+            branches.append((gid, tuple(prog), br.anchored_start, br.anchored_end))
+
+    n = max(1, len(seg_classes))
+    # First pass: intern every indicator channel so the kernel can be
+    # allocated at its final channel count.
+    products: dict[int, tuple] = {}
+    for classes in seg_classes:
+        for mask in classes:
+            if mask not in products:
+                products[mask] = _product_parts(mask)
+            if products[mask] is None:
+                indicator(mask)
+    # Kernel: every position of every channel contributes exactly 2 on match.
+    kernel = np.zeros((w, len(channels), n), dtype=np.float32)
+    for ci, classes in enumerate(seg_classes):
+        for pos in range(w):
+            if pos < len(classes):
+                mask = classes[pos]
+                parts = products[mask]
+                if parts is not None:
+                    his, los = parts
+                    for h in his:
+                        kernel[pos, ch_index[("hi", h)], ci] += 1.0
+                    for lo in los:
+                        kernel[pos, ch_index[("lo", lo)], ci] += 1.0
+                else:
+                    kernel[pos, indicator(mask), ci] += 2.0
+            else:
+                kernel[pos, ch_index[("one",)], ci] += 2.0
+    # Prune embed channels no segment references (e.g. nibble planes of
+    # bytes that never appear) — shrinks both the embed and the matmul K.
+    used = kernel.any(axis=(0, 2))
+    kernel = kernel[:, used, :]
+    channels = [c for c, u in zip(channels, used) if u]
+
+    spec = SegmentSpec(
+        w=w,
+        n_seg=n,
+        channels=tuple(channels),
+        seg_meta=tuple(seg_meta) or ((0, 1),),
+        branches=tuple(branches),
+        always=tuple(always),
+        n_groups=len(plans),
+    )
+    return SegmentBlock(kernel=jnp.asarray(kernel, dtype=jnp.bfloat16), spec=spec)
+
+
+# ---------------------------------------------------------------------------
+# Device-side evaluation
+# ---------------------------------------------------------------------------
+
+
+def _channel_plane(chan: tuple, dpad: jnp.ndarray) -> jnp.ndarray:
+    kind = chan[0]
+    if kind == "hi":
+        return (dpad >> 4) == chan[1]
+    if kind == "lo":
+        return (dpad & 15) == chan[1]
+    if kind == "one":
+        return jnp.ones_like(dpad, dtype=bool)
+    ivs = chan[1]  # ('ind', intervals)
+    acc = jnp.zeros_like(dpad, dtype=bool)
+    for lo, hi in ivs:
+        acc = acc | ((dpad >= lo) & (dpad <= hi)) if lo != hi else acc | (dpad == lo)
+    return acc
+
+
+def _in_class(ivs: tuple, dpad: jnp.ndarray) -> jnp.ndarray:
+    acc = jnp.zeros_like(dpad, dtype=bool)
+    for lo, hi in ivs:
+        acc = acc | ((dpad >= lo) & (dpad <= hi)) if lo != hi else acc | (dpad == lo)
+    return acc
+
+
+def _rshift(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Shift right along axis 1, zero/False fill."""
+    if k == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (k, 0)))[:, : x.shape[1]]
+
+
+def _rshift3(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Shift right along axis 1 of a [T, Q, NB] array, zero fill."""
+    if k == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (k, 0), (0, 0)))[:, : x.shape[1]]
+
+
+def _lshift_fill(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
+    if k == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, k)), constant_values=fill)[:, k:]
+
+
+def _branch_signature(spec: SegmentSpec, prog: tuple, a_start: bool, a_end: bool):
+    """Branches with identical signatures run as one batched chain: the op
+    sequence with all *static shift amounts* (n_lead/n_real/gap bounds and
+    gap classes) — only the conv channel ids differ within a bucket."""
+    sig: list[tuple] = []
+    for el in prog:
+        if el[0] == "seg":
+            n_lead, n_real = spec.seg_meta[el[1]]
+            sig.append(("seg", n_lead, n_real))
+        else:
+            sig.append(el)  # gap params are the signature
+    return (tuple(sig), a_start, a_end)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def match_segment_block(
+    kernel: jnp.ndarray,  # [W, C, N] bf16
+    spec: SegmentSpec,
+    data: jnp.ndarray,  # [T, L] uint8 (zero padded past lengths)
+    lengths: jnp.ndarray,  # [T] int32
+) -> jnp.ndarray:
+    """Returns group hits [T, n_groups] bool."""
+    t, ln = data.shape
+    w = spec.w
+    q = ln + 2  # chain positions: window starts 0 .. L+1
+    # Front NUL pad (position 0) + right slack so every window is full.
+    dpad = jnp.pad(data, ((0, 0), (1, w))).astype(jnp.int32)  # [T, 1+L+W]
+
+    # 1. embed: channel planes from comparisons only.
+    planes = [_channel_plane(c, dpad) for c in spec.channels]
+    embed = jnp.stack(planes, axis=-1).astype(jnp.bfloat16)  # [T, 1+L+W, C]
+
+    # 2. conv: all segments, all start positions. out[t, p, n] == 2W ⇔
+    # segment n matches the window starting at padded position p. (An
+    # im2col-matmul formulation was measured 1.6x SLOWER here — the
+    # [T·Q, W·C] window materialization's HBM traffic exceeds the conv's
+    # MXU inefficiency at these channel counts.)
+    out = jax.lax.conv_general_dilated(
+        embed,
+        kernel,
+        window_strides=(1,),
+        padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=jnp.float32,
+    )  # [T, Q, N]
+    m_all = out >= (2.0 * w)  # equality; >= is safe (2W is the max)
+
+    iota = jnp.arange(q, dtype=jnp.int32)[None, :]  # [1, Q]
+    len1 = 1 + lengths[:, None]  # [T, 1] position just past the last byte
+    iota3 = iota[..., None]  # [1, Q, 1]
+    len3 = len1[..., None]  # [T, 1, 1]
+
+    # 3. chain — branches bucketed by signature, each bucket one batched
+    # program over [T, Q, NB] (v1 ran 1 chain per branch: ~6 ops x
+    # hundreds of branches exploded both compile time and per-op launch
+    # overhead; bucketing collapses it to ~#structures chains).
+    buckets: dict[tuple, list[int]] = {}
+    for bi, (gid, prog, a_start, a_end) in enumerate(spec.branches):
+        buckets.setdefault(_branch_signature(spec, prog, a_start, a_end), []).append(bi)
+
+    # Gap-class tables are built eagerly OUTSIDE the cond-gated chains:
+    # tracers created inside one cond branch must not be cached and reused
+    # inside another trace.
+    _tabs_cache: dict[tuple, tuple] = {}
+    for _, prog, _, _ in spec.branches:
+        for el in prog:
+            if el[0] == "gapcls" and el[1] not in _tabs_cache:
+                in_c = _in_class(el[1], dpad)[:, :q]  # byte at p ∈ class
+                non_c = (~in_c).astype(jnp.int32)
+                nce = jnp.cumsum(non_c, axis=1) - non_c  # non-C in [0, p)
+                _tabs_cache[el[1]] = (in_c, nce)
+
+    def gap_cls_tabs(ivs: tuple):
+        return _tabs_cache[ivs]
+
+    big = jnp.int32(1 << 20)
+
+    def run_bucket(sig: tuple, idxs: list[int]) -> jnp.ndarray:
+        ops, a_start, a_end = sig
+        chan_lists: list[list[int]] = []
+        for gid_prog in idxs:
+            _, prog, _, _ = spec.branches[gid_prog]
+            chans = [el[1] for el in prog if el[0] == "seg"]
+            chan_lists.append(chans)
+        nb = len(idxs)
+
+        # Single-seg unanchored fast path: evaluate at window starts, no
+        # shifts at all (start/end constraints as comparisons on j).
+        if len(ops) == 1 and ops[0][0] == "seg":
+            _, n_lead, n_real = ops[0]
+            m = m_all[:, :, [c[0] for c in chan_lists]]  # [T, Q, NB]
+            r = iota3 + n_lead  # real start for window at j
+            ok = (r >= 1) & (r + n_real <= len3)
+            if a_start:
+                ok = ok & (r == 1)
+            if a_end:
+                ok = ok & (r + n_real == len3)
+            return jnp.any(m & ok, axis=1)  # [T, NB]
+
+        def run_chain(_):
+            e = (iota3 == 1) if a_start else (iota3 >= 1)
+            e = jnp.broadcast_to(e, (t, q, nb))
+            seg_i = 0
+            for op in ops:
+                if op[0] == "seg":
+                    _, n_lead, n_real = op
+                    chans = [cl[seg_i] for cl in chan_lists]
+                    seg_i += 1
+                    m = m_all[:, :, chans]  # [T, Q, NB]
+                    if n_lead:
+                        m = jnp.pad(m, ((0, 0), (n_lead, 0), (0, 0)))[:, :q]
+                    valid = (iota3 >= 1) & (iota3 + n_real <= len3)
+                    e = e & m & valid
+                    if n_real:
+                        e = jnp.pad(e, ((0, 0), (n_real, 0), (0, 0)))[:, :q]
+                elif op[0] == "gapany":
+                    _, lo, hi = op
+                    s = jnp.cumsum(e.astype(jnp.int32), axis=1)
+                    if hi < 0:
+                        e = _rshift3(s, lo) > 0
+                    else:
+                        e = (_rshift3(s, lo) - _rshift3(s, hi + 1)) > 0
+                else:  # gapcls
+                    _, ivs, lo, hi = op
+                    in_c, nce = gap_cls_tabs(ivs)
+                    nce3 = nce[..., None]
+
+                    def clean(d: int, nce3=nce3) -> jnp.ndarray:
+                        if d == 0:
+                            return jnp.ones((t, q, 1), dtype=bool)
+                        return (
+                            jnp.pad(
+                                nce3, ((0, 0), (0, d), (0, 0)), constant_values=big
+                            )[:, d:]
+                            - nce3
+                        ) == 0
+
+                    if hi >= 0:
+                        acc = jnp.zeros_like(e)
+                        for d in range(lo, hi + 1):
+                            acc = acc | _rshift3(e & clean(d), d)
+                        e = acc
+                    else:
+                        e1 = _rshift3(e & clean(lo), lo) if lo else e
+                        # ∃p ≤ q: e1[p] ∧ no non-C byte in [p, q)
+                        #   ⇔ ∃p ≤ q: e1[p] ∧ NCE[p] == NCE[q]  (NCE monotone)
+                        #   ⇔ cummax(e1[p] ? NCE[p] : -1) == NCE[q]
+                        # — one native cummax, not a 7-step custom scan.
+                        h = jax.lax.cummax(
+                            jnp.where(e1, nce3, jnp.int32(-1)), axis=1
+                        )
+                        e = h == nce3
+            if a_end:
+                return jnp.any(e & (iota3 == len3), axis=1)
+            return jnp.any(e & (iota3 <= len3), axis=1)
+
+        # Prefilter gate (the Hyperscan idea as lax.cond): if this bucket's
+        # first segments match NOWHERE in the whole block, no row can match
+        # any of its branches — skip the chain entirely. Worst case is
+        # unchanged; benign-heavy traffic skips almost every chain.
+        first_chans = [cl[0] for cl in chan_lists if cl]
+        if first_chans:
+            pred = jnp.any(m_all[:, :, first_chans])
+            # The no-match branch derives its zeros from m_all so both
+            # branches carry the same varying-axes type under shard_map.
+            no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, nb))
+            return jax.lax.cond(pred, run_chain, lambda _: no_match, None)
+        return run_chain(None)
+
+    # Concatenate bucket outputs (bucket order) and map columns to groups
+    # with one matmul — no scatter (TPU scatter lowering serializes).
+    hits = jnp.zeros((t, spec.n_groups), dtype=bool)
+    if spec.branches:
+        cols: list[jnp.ndarray] = []
+        col_groups: list[int] = []
+        for sig, idxs in buckets.items():
+            cols.append(run_bucket(sig, idxs))  # [T, len(idxs)]
+            col_groups.extend(spec.branches[bi][0] for bi in idxs)
+        bh_all = jnp.concatenate(cols, axis=1)
+        b2g = np.zeros((len(col_groups), spec.n_groups), dtype=np.float32)
+        for ci, gid in enumerate(col_groups):
+            b2g[ci, gid] = 1
+        # bf16 matmul (exact: sums <= branches-per-group << 256); int8
+        # DotGeneral lowers off the MXU on TPU.
+        hits = (
+            jnp.dot(
+                bh_all.astype(jnp.bfloat16),
+                jnp.asarray(b2g, dtype=jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )
+    if spec.always:
+        al = np.zeros(spec.n_groups, dtype=bool)
+        for gid in spec.always:
+            al[gid] = True
+        hits = hits | jnp.asarray(al)[None, :]
+    return hits

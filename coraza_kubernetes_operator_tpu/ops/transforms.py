@@ -51,6 +51,41 @@ def _valid_mask(data: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
     return idx[None, :] < lengths[:, None]
 
 
+# Arithmetic byte classifiers — comparison chains instead of [256]-table
+# gathers (XLA's gather lowering serializes on TPU; these fuse on the VPU).
+
+
+def _hexval(b: jnp.ndarray) -> jnp.ndarray:
+    """Hex digit value of byte, -1 for non-hex."""
+    b = b.astype(jnp.int32)
+    dig = (b >= 0x30) & (b <= 0x39)
+    low = (b >= 0x61) & (b <= 0x66)
+    upp = (b >= 0x41) & (b <= 0x46)
+    return jnp.where(
+        dig, b - 0x30, jnp.where(low, b - 0x57, jnp.where(upp, b - 0x37, -1))
+    )
+
+
+def _digitval(b: jnp.ndarray) -> jnp.ndarray:
+    b = b.astype(jnp.int32)
+    return jnp.where((b >= 0x30) & (b <= 0x39), b - 0x30, -1)
+
+
+def _is_ws(b: jnp.ndarray) -> jnp.ndarray:
+    b = b.astype(jnp.int32)
+    return ((b >= 0x09) & (b <= 0x0D)) | (b == 0x20)
+
+
+def _to_lower(b: jnp.ndarray) -> jnp.ndarray:
+    up = (b >= 0x41) & (b <= 0x5A)
+    return jnp.where(up, b + 0x20, b).astype(b.dtype)
+
+
+def _to_upper(b: jnp.ndarray) -> jnp.ndarray:
+    lo = (b >= 0x61) & (b <= 0x7A)
+    return jnp.where(lo, b - 0x20, b).astype(b.dtype)
+
+
 def _shift_left(x: jnp.ndarray, k: int, fill=0):
     """x[:, i] ← x[:, i+k] (reads past the end become ``fill``)."""
     if k == 0:
@@ -70,15 +105,27 @@ def _shift_right(x: jnp.ndarray, k: int, fill=0):
 def compact(data: jnp.ndarray, keep: jnp.ndarray):
     """Stably move kept bytes to the front of each row; zero-pad the rest.
 
+    Gather-free: kept byte i lands at column ``pos[i] = #kept before i``
+    (exclusive cumsum), realized as a per-row one-hot permutation matmul —
+    the MXU formulation. An earlier argsort+take_along_axis version cost
+    ~50 ms at [16k, 64] (TPU sort lowering); this is ~100x cheaper. bf16
+    is exact for byte values (8 significand bits ⇒ integers ≤ 256).
+
     Returns (data, new_lengths)."""
     n, length = data.shape
+    keep_i = keep.astype(jnp.int32)
+    pos = jnp.cumsum(keep_i, axis=1) - keep_i  # destination column
     idx = jnp.arange(length, dtype=jnp.int32)
-    keys = jnp.where(keep, idx[None, :], idx[None, :] + length)
-    order = jnp.argsort(keys, axis=1, stable=True)
-    packed = jnp.take_along_axis(data, order, axis=1)
+    onehot = keep[:, :, None] & (pos[:, :, None] == idx[None, None, :])
+    # [N, L, L]: source i → dest j (each dest column receives <= 1 source)
+    packed = jnp.einsum(
+        "nl,nlj->nj",
+        data.astype(jnp.bfloat16),
+        onehot.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.uint8)
     new_len = keep.sum(axis=1, dtype=jnp.int32)
-    valid = idx[None, :] < new_len[:, None]
-    return jnp.where(valid, packed, jnp.uint8(0)), new_len
+    return packed, new_len
 
 
 # ---------------------------------------------------------------------------
@@ -87,11 +134,11 @@ def compact(data: jnp.ndarray, keep: jnp.ndarray):
 
 
 def lowercase(data, lengths):
-    return jnp.asarray(_TO_LOWER)[data], lengths
+    return _to_lower(data), lengths
 
 
 def uppercase(data, lengths):
-    return jnp.asarray(_TO_UPPER)[data], lengths
+    return _to_upper(data), lengths
 
 
 def replace_nulls(data, lengths):
@@ -106,13 +153,13 @@ def remove_nulls(data, lengths):
 
 def remove_whitespace(data, lengths):
     valid = _valid_mask(data, lengths)
-    ws = jnp.asarray(_IS_WS)[data]
+    ws = _is_ws(data)
     return compact(data, valid & ~ws)
 
 
 def compress_whitespace(data, lengths):
     valid = _valid_mask(data, lengths)
-    ws = jnp.asarray(_IS_WS)[data] & valid
+    ws = _is_ws(data) & valid
     out = jnp.where(ws, jnp.uint8(0x20), data)
     prev_ws = _shift_right(ws, 1, fill=False)
     return compact(out, valid & ~(ws & prev_ws))
@@ -120,7 +167,7 @@ def compress_whitespace(data, lengths):
 
 def trim(data, lengths):
     valid = _valid_mask(data, lengths)
-    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    non_ws = valid & ~_is_ws(data)
     idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
     big = jnp.int32(data.shape[1] + 1)
     first = jnp.min(jnp.where(non_ws, idx, big), axis=1, keepdims=True)
@@ -130,7 +177,7 @@ def trim(data, lengths):
 
 def trim_left(data, lengths):
     valid = _valid_mask(data, lengths)
-    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    non_ws = valid & ~_is_ws(data)
     idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
     big = jnp.int32(data.shape[1] + 1)
     first = jnp.min(jnp.where(non_ws, idx, big), axis=1, keepdims=True)
@@ -139,7 +186,7 @@ def trim_left(data, lengths):
 
 def trim_right(data, lengths):
     valid = _valid_mask(data, lengths)
-    non_ws = valid & ~jnp.asarray(_IS_WS)[data]
+    non_ws = valid & ~_is_ws(data)
     idx = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
     last = jnp.max(jnp.where(non_ws, idx, -1), axis=1, keepdims=True)
     return compact(data, valid & (idx <= last))
@@ -151,9 +198,8 @@ def url_decode(data, lengths, uni: bool = False):
     Start positions never overlap a decode tail ('%' is not a hex digit and
     not 'u'), so the parallel formulation matches the sequential oracle."""
     valid = _valid_mask(data, lengths)
-    hv = jnp.asarray(_HEXVAL)
     d = [_shift_left(data, k) for k in range(6)]
-    h = [hv[d[k]] for k in range(6)]
+    h = [_hexval(d[k]) for k in range(6)]
     in_bounds = [
         _shift_left(valid.astype(jnp.uint8), k).astype(bool) for k in range(6)
     ]
@@ -201,11 +247,11 @@ def html_entity_decode(data, lengths):
     """Decode ``&#DD;``, ``&#xHH;`` and the named entities ModSecurity
     supports. Entity bodies can't contain '&', so parallel decode is exact."""
     valid = _valid_mask(data, lengths)
-    lower = jnp.asarray(_TO_LOWER)[data]
+    lower = _to_lower(data)
     d = [_shift_left(data, k) for k in range(_MAX_ENTITY + 1)]
     dl = [_shift_left(lower, k) for k in range(_MAX_ENTITY + 1)]
-    hv = [jnp.asarray(_HEXVAL)[x] for x in d]
-    dv = [jnp.asarray(_DIGITVAL)[x] for x in d]
+    hv = [_hexval(x) for x in d]
+    dv = [_digitval(x) for x in d]
     vb = [_shift_left(valid.astype(jnp.uint8), k).astype(bool) for k in range(_MAX_ENTITY + 1)]
 
     amp = (data == 0x26) & valid

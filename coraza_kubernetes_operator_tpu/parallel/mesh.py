@@ -75,11 +75,19 @@ def _stack_shard_banks(shard_dfas: list[list[DFA]]) -> DFABank:
 @dataclass
 class ShardedWafModel:
     """Rule-sharded model: stacked banks + a banks-free post-match model
-    whose ``lgroup`` is remapped to the gathered layout."""
+    whose ``lgroup`` is remapped to the gathered layout.
+
+    The conv-segment tier is **replicated** across rule shards (its
+    kernel is tiny and its cost per target row is far below one DFA
+    bank's); only the DFA banks shard over the rule axis. Global group
+    order: segment blocks (sorted by pipeline) first, then sharded DFA
+    buckets in gathered layout."""
 
     banks: list[DFABank]  # leaves carry leading [n_rule_shards] axis
+    segs: list  # SegmentBlock, replicated
     post: WafModel  # banks == [] — post-match arrays only
     bank_pipelines: tuple  # pipeline id per bucket bank
+    seg_pipelines: tuple
     bucket_widths: tuple  # groups-per-shard per bucket bank
     pipelines: tuple
     host_variant_index: tuple
@@ -87,23 +95,41 @@ class ShardedWafModel:
 
 
 def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafModel:
-    base = build_model(crs)  # reuse bucketing/arrays; we re-stack the banks
+    base = build_model(crs)  # reuse routing/arrays; we re-stack the banks
 
-    # Re-bucket the groups exactly like build_model, but split each bucket
-    # across rule shards with never-match padding.
+    # Re-route the groups exactly like build_model (segment tier first),
+    # but split each DFA bucket across rule shards with never-match padding.
+    from ..compiler.segments import plan_segments
     from ..models.waf_model import _STATE_BUCKETS
+    from ..ops.segment import build_segment_block
 
+    seg_groups: dict[int, list[tuple[int, object]]] = {}
     buckets: dict[tuple[int, int], list[int]] = {}
     for gid, grp in enumerate(crs.groups):
+        pid = crs.group_pipeline[gid]
+        plan = plan_segments(grp.dfa.ast)
+        if plan is not None:
+            seg_groups.setdefault(pid, []).append((gid, plan))
+            continue
         s = grp.dfa.n_states
         bucket = next(b for b in _STATE_BUCKETS if s <= b)
-        buckets.setdefault((crs.group_pipeline[gid], bucket), []).append(gid)
+        buckets.setdefault((pid, bucket), []).append(gid)
+
+    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
+    offset = 0
+    segs = []
+    seg_pipelines: list[int] = []
+    for pid in sorted(seg_groups):
+        items = seg_groups[pid]
+        segs.append(build_segment_block([plan for _, plan in items]))
+        seg_pipelines.append(pid)
+        for g, _ in items:
+            remap[g] = offset
+            offset += 1
 
     banks: list[DFABank] = []
     bank_pipelines: list[int] = []
     bucket_widths: list[int] = []
-    remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
-    offset = 0
     for (pid, _bucket), gids in sorted(buckets.items()):
         width = max(1, math.ceil(len(gids) / n_rule_shards))
         shard_dfas = []
@@ -129,6 +155,7 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
 
     post = WafModel(
         banks=[],
+        segs=[],
         ltype=base.ltype,
         lneg=base.lneg,
         lgroup=jnp.asarray(lgroup),
@@ -150,6 +177,7 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
         weights=base.weights,
         counter_base=base.counter_base,
         bank_pipelines=(),
+        seg_pipelines=(),
         pipelines=base.pipelines,
         pipeline_device=base.pipeline_device,
         host_variant_index=base.host_variant_index,
@@ -159,8 +187,10 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
 
     return ShardedWafModel(
         banks=banks,
+        segs=segs,
         post=post,
         bank_pipelines=tuple(bank_pipelines),
+        seg_pipelines=tuple(seg_pipelines),
         bucket_widths=tuple(bucket_widths),
         pipelines=base.pipelines,
         host_variant_index=base.host_variant_index,
@@ -175,20 +205,22 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
     leading [n_rule] axis. Output leaves carry [n_data]."""
     n_rule = model.n_rule_shards
 
+    from ..ops.segment import match_segment_block
+
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P("rule"), P(), P("data")),
+        in_specs=(P("rule"), P(), P(), P("data")),
         out_specs=P("data"),
     )
-    def run(banks, post, shard_tensors):
+    def run(banks, segs, post, shard_tensors):
         banks = jax.tree.map(lambda x: x[0], banks)  # squeeze rule block
         (data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths) = jax.tree.map(
             lambda x: x[0], shard_tensors
         )  # squeeze data block
-        per_bucket = []
         transformed = {}
-        for bank, pid in zip(banks, model.bank_pipelines):
+
+        def transformed_for(pid):
             if pid not in transformed:
                 slot = model.host_variant_index[pid]
                 if slot >= 0:
@@ -197,18 +229,33 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
                     transformed[pid] = apply_device_pipeline(
                         data, lengths, model.pipelines[pid]
                     )
-            per_bucket.append(scan_dfa_bank(bank, *transformed[pid]))
-        sub = jnp.concatenate(per_bucket, axis=1)  # [T, sum(width)]
-        # The one collective: per-target hit bits across rule shards (ICI).
-        gathered = jax.lax.all_gather(sub, "rule")  # [R, T, W]
-        t = sub.shape[0]
-        cols = []
-        o = 0
-        for width in model.bucket_widths:
-            blk = gathered[:, :, o : o + width]  # [R, T, w]
-            cols.append(jnp.moveaxis(blk, 0, 1).reshape(t, n_rule * width))
-            o += width
-        group_hits = jnp.concatenate(cols, axis=1)  # [T, G_gathered]
+            return transformed[pid]
+
+        # Segment tier: replicated (identical on every rule shard).
+        seg_cols = []
+        for seg, pid in zip(segs, model.seg_pipelines):
+            tdata, tlen = transformed_for(pid)
+            seg_cols.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
+
+        per_bucket = []
+        for bank, pid in zip(banks, model.bank_pipelines):
+            per_bucket.append(scan_dfa_bank(bank, *transformed_for(pid)))
+        t = data.shape[0]
+        cols = list(seg_cols)
+        if per_bucket:
+            sub = jnp.concatenate(per_bucket, axis=1)  # [T, sum(width)]
+            # The one collective: per-target hit bits across rule shards (ICI).
+            gathered = jax.lax.all_gather(sub, "rule")  # [R, T, W]
+            o = 0
+            for width in model.bucket_widths:
+                blk = gathered[:, :, o : o + width]  # [R, T, w]
+                cols.append(jnp.moveaxis(blk, 0, 1).reshape(t, n_rule * width))
+                o += width
+        group_hits = (
+            jnp.concatenate(cols, axis=1)
+            if cols
+            else jnp.zeros((t, 1), dtype=bool)
+        )  # [T, G_gathered]
         out = post_match(post, group_hits, k1, k2, k3, req_id, numvals)
         # Post-gather values are identical on every rule shard; an idempotent
         # pmax makes that replication explicit to the vma type system.
@@ -217,7 +264,7 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
         )
         return jax.tree.map(lambda x: x[None], out)  # restore data axis
 
-    return run(model.banks, model.post, tensors)
+    return run(model.banks, model.segs, model.post, tensors)
 
 
 @dataclass
