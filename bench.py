@@ -216,10 +216,13 @@ def main() -> None:
     for key in ("1", "2", "3", "4", "5"):
         if key not in wanted:
             continue
-        try:
-            configs[key] = runners[key]()
-        except Exception as err:  # keep the harness robust to tunnel flakes
-            configs[key] = {"error": f"{type(err).__name__}: {err}"}
+        for attempt in (1, 2):  # one retry: the axon tunnel's remote_compile
+            try:  # endpoint occasionally drops large compiles mid-stream
+                configs[key] = runners[key]()
+                break
+            except Exception as err:
+                configs[key] = {"error": f"{type(err).__name__}: {err}"}
+                time.sleep(5)
 
     headline = configs.get("3", {}).get("req_per_s")
     if headline is None:  # fall back to any successful config

@@ -200,13 +200,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-bind-address", default="",
                    help="empty disables the metrics endpoint (reference default)")
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path; auto-detects $KUBECONFIG / in-cluster "
+                        "service account / ~/.kube/config when omitted")
+    p.add_argument("--namespace", default="",
+                   help="restrict watches to one namespace (default: all)")
     p.add_argument("--manifest-dir", default="",
-                   help="directory of CR manifests (out-of-cluster object source)")
+                   help="directory of CR manifests (fallback object source when "
+                        "no cluster is reachable)")
     p.add_argument("--workers", type=int, default=2)
     return p
 
 
-def main(argv: list[str] | None = None) -> int:
+def main(argv: list[str] | None = None, stop: threading.Event | None = None) -> int:
+    """Run the operator. ``stop`` lets embedders (tests) request shutdown;
+    when run as the process entrypoint SIGINT/SIGTERM set it instead."""
     args = build_parser().parse_args(argv)
 
     store = ObjectStore()
@@ -228,6 +236,27 @@ def main(argv: list[str] | None = None) -> int:
         workers=args.workers,
     )
 
+    # Object source: a real API server when reachable (list+watch streams,
+    # SSA write-back, Lease election — reference cmd/main.go:179-238),
+    # manifest-dir as the out-of-cluster fallback.
+    from ..controlplane.kubeclient import (
+        ClusterSource,
+        KubeClient,
+        KubeConfig,
+        LeaseElector,
+    )
+
+    cluster_source: ClusterSource | None = None
+    elector: LeaseElector | None = None
+    kube_cfg = KubeConfig.detect(args.kubeconfig or None)
+    if kube_cfg is not None:
+        client = KubeClient(kube_cfg)
+        cluster_source = ClusterSource(
+            store, client, namespace=args.namespace or None
+        )
+        if args.leader_elect:
+            elector = LeaseElector(client)
+
     source: ManifestSource | None = None
     if args.manifest_dir:
         source = ManifestSource(store, Path(args.manifest_dir))
@@ -240,16 +269,30 @@ def main(argv: list[str] | None = None) -> int:
             args.metrics_bind_address, ready.is_set, cache_server.metrics
         )
 
-    stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+    if stop is None:
+        stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
 
     if args.leader_elect:
-        # Standalone latch; in-cluster deployments back this with a Lease.
-        log.info("leader election enabled (standalone latch acquired)")
+        if elector is not None:
+            # Real Lease-based election: block startup until leadership
+            # is won (controller-runtime manager semantics).
+            elector.start()
+            log.info("waiting for leader election", identity=elector.identity)
+            while not elector.wait_for_leadership(1.0):
+                if stop.is_set():
+                    elector.stop()
+                    return 0
+        else:
+            log.info("leader election enabled (standalone latch acquired: "
+                     "no API server reachable)")
 
     cache_server.start()
     manager.start()
+    if cluster_source is not None:
+        cluster_source.start()
     if source is not None:
         source.sync_once()
         source.start()
@@ -259,12 +302,17 @@ def main(argv: list[str] | None = None) -> int:
         cachePort=cache_server.port,
         probes=args.health_probe_bind_address,
         metrics=args.metrics_bind_address or "(disabled)",
+        cluster=f"{kube_cfg.host}:{kube_cfg.port}" if kube_cfg else "(none)",
         manifestDir=args.manifest_dir or "(none)",
     )
     stop.wait()
     ready.clear()
     if source is not None:
         source.stop()
+    if cluster_source is not None:
+        cluster_source.stop()
+    if elector is not None:
+        elector.stop()
     manager.stop()
     cache_server.stop()
     for srv in (probe_srv, metrics_srv):

@@ -24,16 +24,23 @@ class NotFoundError(KeyError):
 
 
 class ObjectStore:
-    """Objects bucketed by kind, keyed (namespace, name)."""
+    """Objects bucketed by kind, keyed (namespace, name).
+
+    Cluster write-back seam: ``on_apply`` / ``on_status`` hooks (set by
+    ``kubeclient.ClusterSource``) mirror controller writes to a real API
+    server; mutations arriving *from* the cluster watch pass
+    ``sync=False`` so they don't echo back."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._objects: dict[str, dict[tuple[str, str], Any]] = defaultdict(dict)
         self._watchers: dict[str, list[WatchHandler]] = defaultdict(list)
+        self.on_apply: Callable[[Any], None] | None = None
+        self.on_status: Callable[[Any], None] | None = None
 
     # -- client interface ---------------------------------------------------
 
-    def create(self, obj: Any) -> Any:
+    def create(self, obj: Any, sync: bool = True) -> Any:
         with self._lock:
             kind = obj.kind
             key = obj.metadata.key
@@ -67,7 +74,7 @@ class ObjectStore:
             objs = [o for o in objs if o.metadata.namespace == namespace]
         return objs
 
-    def update(self, obj: Any, bump_generation: bool = True) -> Any:
+    def update(self, obj: Any, bump_generation: bool = True, sync: bool = True) -> Any:
         with self._lock:
             kind = obj.kind
             key = obj.metadata.key
@@ -88,19 +95,23 @@ class ObjectStore:
         with self._lock:
             obj.metadata.resource_version += 1
             self._objects[obj.kind][obj.metadata.key] = obj
+        if self.on_status is not None:
+            self.on_status(obj)
         return obj
 
     def apply(self, obj: Any) -> Any:
         """Server-side-apply equivalent: create-or-overwrite by key
-        (reference ``utils.go:114-138`` with ForceOwnership)."""
+        (reference ``utils.go:114-138`` with ForceOwnership); mirrored to
+        the cluster when a ClusterSource is attached."""
         with self._lock:
             kind = obj.kind
             exists = obj.metadata.key in self._objects[kind]
-        if exists:
-            return self.update(obj)
-        return self.create(obj)
+        out = self.update(obj) if exists else self.create(obj)
+        if self.on_apply is not None:
+            self.on_apply(out)
+        return out
 
-    def delete(self, kind: str, namespace: str, name: str) -> None:
+    def delete(self, kind: str, namespace: str, name: str, sync: bool = True) -> None:
         with self._lock:
             obj = self._objects[kind].pop((namespace, name), None)
         if obj is None:

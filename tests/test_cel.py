@@ -1,0 +1,88 @@
+"""Mini-CEL evaluator + CRD schema validation units.
+
+The CRD YAML's x-kubernetes-validations are executable now; these tests
+pin the evaluator semantics the fake apiserver and cluster store rely on.
+"""
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.controlplane.cel import (
+    CelError,
+    compile_rule,
+)
+from coraza_kubernetes_operator_tpu.controlplane.crdschema import (
+    ValidationError,
+    load_crds,
+)
+
+
+def ev(src, self_value):
+    return compile_rule(src).evaluate(self_value)
+
+
+def test_literals_and_operators():
+    assert ev("1 + 2 == 3", {})
+    assert ev("'a' != 'b'", {})
+    assert ev("2 > 1 && 1 < 2", {})
+    assert ev("false || true", {})
+    assert ev("!false", {})
+    assert ev("true ? 1 : 2", {}) == 1
+    assert ev("'b' in ['a', 'b']", {})
+
+
+def test_has_and_select():
+    assert ev("has(self.istio)", {"istio": {}})
+    assert not ev("has(self.istio)", {})
+    assert not ev("has(self.istio)", {"istio": None})
+    assert ev("has(self.a.b.c)", {"a": {"b": {"c": 1}}})
+    assert not ev("has(self.a.b.c)", {"a": {"b": {}}})
+
+
+def test_driver_union_rule():
+    rule = "[has(self.istio), has(self.tpu)].filter(x, x).size() == 1"
+    assert ev(rule, {"istio": {}})
+    assert ev(rule, {"tpu": {}})
+    assert not ev(rule, {})
+    assert not ev(rule, {"istio": {}, "tpu": {}})
+
+
+def test_gateway_selector_rule():
+    rule = (
+        "self.mode != 'gateway' || "
+        "(has(self.workloadSelector) && has(self.workloadSelector.matchLabels))"
+    )
+    assert ev(rule, {"mode": "gateway", "workloadSelector": {"matchLabels": {"a": "b"}}})
+    assert not ev(rule, {"mode": "gateway"})
+    assert ev(rule, {"mode": "sidecar"})
+
+
+def test_string_methods():
+    assert ev("self.image.startsWith('oci://')", {"image": "oci://x"})
+    assert ev("self.name.matches('^[a-z]+$')", {"name": "abc"})
+    assert ev("self.msg.contains('boom')", {"msg": "a boom b"})
+    assert ev("size(self.items) == 2", {"items": [1, 2]})
+    assert ev("self.items.exists(i, i > 1)", {"items": [1, 2]})
+    assert ev("self.items.all(i, i > 0)", {"items": [1, 2]})
+
+
+def test_parse_errors():
+    with pytest.raises(CelError):
+        compile_rule("self.")
+    with pytest.raises(CelError):
+        compile_rule("has(")
+    with pytest.raises(CelError):
+        compile_rule("self ~ 3")
+
+
+def test_crd_schema_round_trip():
+    crds = load_crds()
+    assert set(crds) == {"Engine", "RuleSet"}
+    eng = crds["Engine"]
+    with pytest.raises(ValidationError) as err:
+        eng.validate(
+            {
+                "metadata": {"name": "x"},
+                "spec": {"ruleSet": {"name": "rs"}, "driver": {}},
+            }
+        )
+    assert "exactly one driver must be configured" in str(err.value)
