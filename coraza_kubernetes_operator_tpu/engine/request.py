@@ -54,6 +54,16 @@ class HttpRequest:
 
 
 @dataclass
+class HttpResponse:
+    """Upstream response for phases 3/4 (``SecResponseBodyAccess``)."""
+
+    status: int = 200
+    headers: list[tuple[str, str]] = field(default_factory=list)
+    body: bytes = b""
+    version: str = "HTTP/1.1"
+
+
+@dataclass
 class ExtractedTarget:
     collection: str
     name: str | None  # selector key (lower-cased at match time)
@@ -106,10 +116,28 @@ class TargetExtractor:
         self.vocab = crs.vocab
         self.body_access = crs.program.request_body_access
         self.body_limit = crs.program.request_body_limit
+        self.response_body_access = crs.program.response_body_access
+        self.response_body_limit = crs.program.response_body_limit
 
-    def extract(self, req: HttpRequest) -> Extraction:
+    def extract(
+        self,
+        req: HttpRequest,
+        phase1_only: bool = False,
+        response: HttpResponse | None = None,
+    ) -> Extraction:
+        """Extract match targets.
+
+        ``phase1_only`` is the data plane's early-phase pass (reference
+        SURVEY §3.4: phase 1 decides on headers *before* the body is
+        read): the request body is never touched — no body parse, no
+        ARGS_POST, no REQUEST_BODY/FULL_REQUEST body bytes — so a
+        phase-1 deny short-circuits body ingest entirely.
+
+        ``response`` adds the phase-3/4 collections (RESPONSE_STATUS,
+        RESPONSE_HEADERS[_NAMES], STATUS_LINE, RESPONSE_BODY gated by
+        ``SecResponseBodyAccess``/``SecResponseBodyLimit``)."""
         targets: list[ExtractedTarget] = []
-        body = req.body[: self.body_limit]
+        body = b"" if phase1_only else req.body[: self.body_limit]
         reqbody_error = 0
 
         args_get = _parse_pairs(req.query_string)
@@ -153,6 +181,20 @@ class TargetExtractor:
                 add("REQUEST_COOKIES", name, value.encode("latin-1", "replace"))
                 add("REQUEST_COOKIES_NAMES", name, name.encode("latin-1", "replace"))
 
+        status_line = b""
+        response_body = b""
+        response_status = 0
+        if response is not None:
+            response_status = response.status
+            status_line = f"{response.version} {response.status}".encode(
+                "latin-1", "replace"
+            )
+            for hk, hv in response.headers:
+                add("RESPONSE_HEADERS", hk, hv.encode("latin-1", "replace"))
+                add("RESPONSE_HEADERS_NAMES", hk, hk.encode("latin-1", "replace"))
+            if self.response_body_access:
+                response_body = response.body[: self.response_body_limit]
+
         path = req.path
         basename = path.rsplit("/", 1)[-1]
         request_line = f"{req.method} {req.uri} {req.version}"
@@ -177,8 +219,8 @@ class TargetExtractor:
             "PATH_INFO": b"",
             "REMOTE_ADDR": req.remote_addr.encode("latin-1", "replace"),
             "SERVER_NAME": (req.header("host") or "").encode("latin-1", "replace"),
-            "STATUS_LINE": b"",
-            "RESPONSE_BODY": b"",
+            "STATUS_LINE": status_line,
+            "RESPONSE_BODY": response_body,
             "AUTH_TYPE": b"",
             "REQBODY_PROCESSOR": processor.encode("ascii"),
         }
@@ -195,7 +237,7 @@ class TargetExtractor:
             "ARGS_COMBINED_SIZE": args_combined,
             "FULL_REQUEST_LENGTH": len(full_request),
             "FILES_COMBINED_SIZE": 0,
-            "RESPONSE_STATUS": 0,
+            "RESPONSE_STATUS": response_status,
             "DURATION": 0,
         }
         # Numeric scalars used with string operators appear as byte targets.

@@ -222,3 +222,62 @@ class WafEngine:
 
     def evaluate_one(self, request: HttpRequest) -> Verdict:
         return self.evaluate([request])[0]
+
+    # -- phase-split serving -------------------------------------------------
+
+    def _evaluate_extractions(
+        self, extractions: list, max_phase: int
+    ) -> list[Verdict]:
+        from ..models.waf_model import eval_waf_compact, unpack_compact
+
+        tensors = self._tensorize(extractions)
+        packed = jax.device_get(
+            eval_waf_compact(self.model, *tensors, max_phase=max_phase)
+        )
+        head, matched, scores = unpack_compact(
+            packed, self.model.n_rules, self.model.n_counters
+        )
+        counters = list(enumerate(self.compiled.counters))
+        verdicts: list[Verdict] = []
+        for i in range(len(extractions)):
+            ridx = int(head[i, 2])
+            verdicts.append(
+                Verdict(
+                    interrupted=bool(head[i, 0]),
+                    status=int(head[i, 1]),
+                    rule_id=int(self._rule_ids[ridx]) if ridx >= 0 else None,
+                    matched_ids=[
+                        int(self._rule_ids[j])
+                        for j in np.flatnonzero(matched[i])
+                        if j < self._n_real_rules
+                    ],
+                    scores={name: int(scores[i, c]) for c, name in counters},
+                )
+            )
+        return verdicts
+
+    def evaluate_phased(self, requests: list[HttpRequest]) -> list[Verdict]:
+        """Two-pass phase-split evaluation (reference data-plane semantics,
+        SURVEY §3.4): phase-1 rules decide on headers BEFORE the body is
+        read — pass 1 never touches ``req.body`` (no parse, no tensorize);
+        only requests that survive run the full request phases."""
+        if not requests:
+            return []
+        pass1 = [
+            self.extractor.extract(r, phase1_only=True) for r in requests
+        ]
+        early = self._evaluate_extractions(pass1, max_phase=1)
+        survivors = [i for i, v in enumerate(early) if not v.interrupted]
+        if survivors:
+            full = self.evaluate([requests[i] for i in survivors])
+            for i, verdict in zip(survivors, full):
+                early[i] = verdict
+        return early
+
+    def evaluate_response(self, request: HttpRequest, response) -> Verdict:
+        """Phases 3/4: evaluate the upstream response (plus the request
+        context) — RESPONSE_STATUS/HEADERS/STATUS_LINE and, when
+        ``SecResponseBodyAccess On``, RESPONSE_BODY up to
+        ``SecResponseBodyLimit``."""
+        ex = self.extractor.extract(request, response=response)
+        return self._evaluate_extractions([ex], max_phase=4)[0]

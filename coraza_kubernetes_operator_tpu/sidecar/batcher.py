@@ -86,7 +86,11 @@ class MicroBatcher:
         engine_fn,
         max_batch_size: int = DEFAULT_MAX_BATCH_SIZE,
         max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
+        phase_split: bool = False,
     ):
+        # phase_split: evaluate phase-1 (headers) before body ingest —
+        # early denials never tensorize their bodies (SURVEY §3.4).
+        self.phase_split = phase_split
         # engine_fn(tenant) -> WafEngine | None. Single-tenant callers may
         # pass a zero-arg callable; it is adapted below.
         import inspect
@@ -186,7 +190,11 @@ class MicroBatcher:
                     window[i][2].set_exception(err)
                 continue
             try:
-                verdicts = engine.evaluate([window[i][0] for i in idxs])
+                reqs = [window[i][0] for i in idxs]
+                if self.phase_split:
+                    verdicts = engine.evaluate_phased(reqs)
+                else:
+                    verdicts = engine.evaluate(reqs)
             except Exception as err:  # evaluation failure → per-request error
                 log.error("batch evaluation failed", err, batch=len(idxs))
                 self.stats.errors += len(idxs)

@@ -74,6 +74,11 @@ class SidecarConfig:
     # SecAuditLog /dev/stdout shape), anything else a file path.
     audit_log: str | None = None
     audit_relevant_only: bool = True
+    # Evaluate phase-1 rules on headers before body ingest (early denial
+    # without body tensorization — the reference data plane's phase
+    # ordering, SURVEY §3.4). Costs a second device pass per window when
+    # any phase-1 rule exists; disable for single-pass throughput.
+    phase_split: bool = False
     # Honor X-Waf-Tenant (filter mode) and per-request/header tenant
     # selection (bulk mode). Off by default: both surfaces share the same
     # unauthenticated listener, so tenant selection from request content
@@ -306,6 +311,7 @@ class TpuEngineSidecar:
             engine_fn=lambda tenant: self.tenants.engine_for(tenant),
             max_batch_size=config.max_batch_size,
             max_batch_delay_ms=config.max_batch_delay_ms,
+            phase_split=config.phase_split,
         )
         self.metrics = MetricsRegistry()
         self._m_requests = self.metrics.counter(
