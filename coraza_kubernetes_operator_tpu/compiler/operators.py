@@ -30,6 +30,34 @@ NUMERIC_OPS = {"eq", "ne", "ge", "gt", "le", "lt"}
 CMP_CODES = {"eq": 0, "ne": 1, "ge": 2, "gt": 3, "le": 4, "lt": 5}
 
 
+def _load_pm_file(arg: str, env: dict[str, str]) -> list[bytes]:
+    """Resolve and parse ``@pmFromFile`` data files (CRS ``*.data`` shape:
+    one phrase per line, ``#`` comments, blank lines ignored). Relative
+    paths resolve against ``SecDataDir``. Multiple files may be listed."""
+    from pathlib import Path
+
+    base = env.get("__secdatadir__", "")
+    words: list[bytes] = []
+    for name in arg.split():
+        path = Path(name)
+        if not path.is_absolute() and base:
+            path = Path(base) / path
+        try:
+            raw = path.read_bytes()
+        except OSError as err:
+            raise UnsupportedOperator(
+                f"@pmFromFile {name}: unreadable ({err}); set SecDataDir or "
+                "use an absolute path"
+            ) from err
+        for line in raw.splitlines():
+            line = line.split(b"#", 1)[0].strip()
+            if line:
+                words.append(line)
+    if not words:
+        raise UnsupportedOperator(f"@pmFromFile {arg}: no phrases found")
+    return words
+
+
 def expand_macros(arg: str, env: dict[str, str]) -> str:
     """Expand ``%{tx.name}`` macros from the compile-time TX environment
     (populated by unconditional SecAction setvars, e.g. CRS thresholds)."""
@@ -109,17 +137,39 @@ def _byte_range_dfa(arg: str) -> DFA:
 
 
 _VALIDATE_URLENC = "%([^0-9A-Fa-f]|$|[0-9A-Fa-f]([^0-9A-Fa-f]|$))"
-# Approximate UTF-8 validation: lead bytes lacking continuations, forbidden
-# lead values, and a stray continuation at start of input. (Mid-stream stray
-# continuations need lookbehind — flagged as an approximation.)
-_VALIDATE_UTF8 = (
-    "([\\xC2-\\xDF]([^\\x80-\\xBF]|$))"
-    "|([\\xE0-\\xEF]([^\\x80-\\xBF]|$|[\\x80-\\xBF]([^\\x80-\\xBF]|$)))"
-    "|([\\xF0-\\xF4]([^\\x80-\\xBF]|$|[\\x80-\\xBF]([^\\x80-\\xBF]|$"
-    "|[\\x80-\\xBF]([^\\x80-\\xBF]|$))))"
-    "|[\\xC0\\xC1\\xF5-\\xFF]"
-    "|^[\\x80-\\xBF]"
+
+# EXACT UTF-8 validation without lookaround: anchor at start-of-input,
+# consume any number of VALID units, then require one INVALID unit start.
+# A byte string contains an encoding error iff its longest valid prefix is
+# followed by a non-unit — this formulation IS that definition, so it is
+# exact (the round-1 approximation missed mid-stream stray continuations).
+# Valid units enforce the ModSecurity checks: continuation counts,
+# overlongs (E0 A0.., F0 90..), surrogates (ED 80-9F only), max U+10FFFF
+# (F4 80-8F only), never-valid leads C0/C1/F5-FF.
+_UTF8_UNIT = (
+    "(?:[\\x00-\\x7F]"
+    "|[\\xC2-\\xDF][\\x80-\\xBF]"
+    "|\\xE0[\\xA0-\\xBF][\\x80-\\xBF]"
+    "|[\\xE1-\\xEC\\xEE\\xEF][\\x80-\\xBF][\\x80-\\xBF]"
+    "|\\xED[\\x80-\\x9F][\\x80-\\xBF]"
+    "|\\xF0[\\x90-\\xBF][\\x80-\\xBF][\\x80-\\xBF]"
+    "|[\\xF1-\\xF3][\\x80-\\xBF][\\x80-\\xBF][\\x80-\\xBF]"
+    "|\\xF4[\\x80-\\x8F][\\x80-\\xBF][\\x80-\\xBF])"
 )
+_UTF8_INVALID = (
+    "(?:[\\x80-\\xBF\\xC0\\xC1\\xF5-\\xFF]"
+    "|[\\xC2-\\xDF](?:[^\\x80-\\xBF]|$)"
+    "|\\xE0(?:[^\\xA0-\\xBF]|$|[\\xA0-\\xBF](?:[^\\x80-\\xBF]|$))"
+    "|[\\xE1-\\xEC\\xEE\\xEF](?:[^\\x80-\\xBF]|$|[\\x80-\\xBF](?:[^\\x80-\\xBF]|$))"
+    "|\\xED(?:[^\\x80-\\x9F]|$|[\\x80-\\x9F](?:[^\\x80-\\xBF]|$))"
+    "|\\xF0(?:[^\\x90-\\xBF]|$|[\\x90-\\xBF](?:[^\\x80-\\xBF]|$"
+    "|[\\x80-\\xBF](?:[^\\x80-\\xBF]|$)))"
+    "|[\\xF1-\\xF3](?:[^\\x80-\\xBF]|$|[\\x80-\\xBF](?:[^\\x80-\\xBF]|$"
+    "|[\\x80-\\xBF](?:[^\\x80-\\xBF]|$)))"
+    "|\\xF4(?:[^\\x80-\\x8F]|$|[\\x80-\\x8F](?:[^\\x80-\\xBF]|$"
+    "|[\\x80-\\xBF](?:[^\\x80-\\xBF]|$))))"
+)
+_VALIDATE_UTF8 = f"^{_UTF8_UNIT}*{_UTF8_INVALID}"
 
 
 @dataclass
@@ -157,10 +207,16 @@ def lower_string_operator(op: Operator, env: dict[str, str]) -> StringOpPlan:
     if name == "pm":
         words = [w.encode("latin-1", errors="replace") for w in arg.split()]
         return StringOpPlan(pm_dfa(words), expanded_arg=arg)
-    if name in ("pmf", "pmfromfile", "ipmatchfromfile"):
-        raise UnsupportedOperator(
-            f"@{name} requires external files (reference corpus strips these too)"
-        )
+    if name in ("pmf", "pmfromfile"):
+        # Vendored data files (CRS *.data shape: one phrase per line, '#'
+        # comments). The reference corpus STRIPS these rules because
+        # coraza-proxy-wasm has no filesystem (generate_coreruleset_
+        # configmaps.py --ignore-pmFromFile); first-party data plane means
+        # we can support them (gated on a configured data dir).
+        words = _load_pm_file(arg, env)
+        return StringOpPlan(pm_dfa(words), expanded_arg=arg)
+    if name == "ipmatchfromfile":
+        raise UnsupportedOperator("@ipmatchfromfile has no TPU lowering yet")
     if name == "detectsqli":
         return StringOpPlan(compile_regex_dfa(_DETECT_SQLI), approximate=True, expanded_arg=arg)
     if name == "detectxss":
@@ -170,7 +226,8 @@ def lower_string_operator(op: Operator, env: dict[str, str]) -> StringOpPlan:
     if name == "validateurlencoding":
         return StringOpPlan(compile_regex_dfa(_VALIDATE_URLENC), expanded_arg=arg)
     if name == "validateutf8encoding":
-        return StringOpPlan(compile_regex_dfa(_VALIDATE_UTF8), approximate=True, expanded_arg=arg)
+        # Exact (differential-tested against Python's UTF-8 decoder).
+        return StringOpPlan(compile_regex_dfa(_VALIDATE_UTF8), expanded_arg=arg)
     raise UnsupportedOperator(f"@{name} has no TPU lowering yet")
 
 

@@ -422,6 +422,10 @@ class _Lowering:
         self.rules: list[CompiledRule] = []
         self.rule_setvars: list[list[tuple[str, str, str]]] = []
         self.env: dict[str, str] = {}
+        # @pmFromFile resolution root: SecDataDir (ModSecurity's data-file
+        # directory directive), read by operators._load_pm_file.
+        if program.config.get("secdatadir"):
+            self.env["__secdatadir__"] = program.config["secdatadir"]
         self.counters: list[str] = []
         # TX vars written by *conditional* rules are runtime state (anomaly
         # counters) — never compile-time constants.
@@ -481,6 +485,37 @@ class _Lowering:
 
         if op.name in NUMERIC_OPS:
             return self._lower_numeric_link(link, rule_id)
+
+        if op.name == "detectsqli":
+            # Host-evaluated libinjection-architecture detector
+            # (compiler/sqli.py): tokenizer+fingerprint semantics cannot
+            # lower to a regex, so the extractor computes a per-request
+            # bit over the rule's (transformed) targets and the device
+            # consumes it as a numeric link. Mirrors Coraza evaluating
+            # libinjection-go on the host CPU (reference go.mod:24).
+            include: list[int] = []
+            exclude: list[int] = []
+            for var in link.variables:
+                kinds, err = self._kinds_of_variable(var, string_ctx=True)
+                if err:
+                    self.report.skip(rule_id, err)
+                    continue
+                (exclude if var.exclude else include).extend(kinds)
+            if not include:
+                return None
+            nv = self.numvars.intern(
+                ("hostop", "sqli", pipeline, tuple(include), tuple(exclude))
+            )
+            self.links.append(
+                CompiledLink(
+                    LINK_NUMERIC,
+                    negated=op.negated,
+                    numvar=nv,
+                    cmp=CMP_CODES["eq"],
+                    cmp_arg=1,
+                )
+            )
+            return len(self.links) - 1
 
         # String operator path. Unsupported-but-valid features are skipped
         # with a report entry (mirroring the corpus generator's

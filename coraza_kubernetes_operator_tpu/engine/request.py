@@ -249,6 +249,11 @@ class TargetExtractor:
         for key, _nv in self.crs.numvars.vars.items():
             if key[0] == "scalar":
                 numerics[key] = numeric_values.get(key[1], 0)
+            elif key[0] == "hostop":
+                # Host-evaluated operator bit (e.g. @detectSQLi via the
+                # libinjection-architecture detector): OR over this rule's
+                # targets after its host transform pipeline.
+                numerics[key] = self._eval_hostop(key, targets)
             else:  # ('count', collection, selector)
                 _, coll, sel = key
                 count = 0
@@ -259,6 +264,22 @@ class TargetExtractor:
                         count += 1
                 numerics[key] = count
         return Extraction(targets=targets, numerics=numerics)
+
+    def _eval_hostop(self, key: tuple, targets: list[ExtractedTarget]) -> int:
+        from ..compiler.sqli import is_sqli
+        from ..compiler.transforms_host import apply_pipeline
+
+        _, opname, pipeline, include, exclude = key
+        inc = set(include)
+        exc = set(exclude)
+        for t in targets:
+            kinds = set(self.kind_ids(t))
+            if not (kinds & inc) or (kinds & exc):
+                continue
+            value = apply_pipeline(t.value, list(pipeline))
+            if opname == "sqli" and is_sqli(value)[0]:
+                return 1
+        return 0
 
     def kind_ids(self, target: ExtractedTarget) -> list[int]:
         """All kind ids this target belongs to (generic, exact selector, and

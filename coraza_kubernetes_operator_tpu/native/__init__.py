@@ -173,6 +173,10 @@ def serialize_config(crs) -> bytes | None:
     nv_specs = sorted(crs.numvars.vars.items(), key=lambda kv: kv[1])
     nv_blobs = []
     for key, _slot in nv_specs:
+        if key[0] == "hostop":
+            # Host-evaluated operator bits (libinjection-architecture
+            # @detectSQLi) have no native evaluator yet → python fallback.
+            return None
         if key[0] == "scalar":
             try:
                 sid = _NUMERIC_ORDER.index(key[1])
