@@ -40,6 +40,13 @@ test.all:  ## Both tiers in one run.
 test.integration:  ## In-process integration scenarios (cache+sidecar+controllers).
 	$(PYTHON) -m pytest tests/test_engine_e2e.py tests/test_sidecar.py tests/test_ftw.py -q
 
+.PHONY: ftw.crs-lite
+ftw.crs-lite:  ## Conformance: crs-lite corpus (CRS v4-structured) in-process.
+	$(PYTHON) -c "from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text; \
+	from coraza_kubernetes_operator_tpu.ftw.runner import run_corpus; import json, sys; \
+	r = run_corpus('ftw/tests-crs-lite', load_ruleset_text()); \
+	print(json.dumps(r.summary())); sys.exit(0 if r.ok else 1)"
+
 .PHONY: bench
 bench:  ## One-line JSON throughput/latency benchmark (TPU if available).
 	$(PYTHON) bench.py

@@ -1,0 +1,27 @@
+"""crs-lite corpus loading: setup-first file ordering + SecDataDir.
+
+The reference pipeline concatenates the CRS base config before the rule
+files (``hack/generate_coreruleset_configmaps.py`` embeds it, the
+Makefile orders ``crs-setup`` first); plain lexicographic globbing would
+put ``REQUEST-*.conf`` before ``crs-setup.conf`` and break compile-time
+threshold resolution."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parents[2] / "ftw" / "rules"
+CRS_LITE_DIR = _HERE / "crs-lite"
+
+
+def load_ruleset_text(root: str | Path = CRS_LITE_DIR) -> str:
+    """Concatenate a CRS-layout rules directory: ``crs-setup.conf`` (and
+    any other non-REQUEST config) first, then the rule files in CRS
+    order; SecDataDir pinned to the corpus ``data/`` directory."""
+    root = Path(root)
+    setup = sorted(p for p in root.glob("*.conf") if not p.name.startswith("REQUEST-"))
+    rules = sorted(p for p in root.glob("*.conf") if p.name.startswith("REQUEST-"))
+    parts = [f"SecDataDir {root / 'data'}"]
+    for path in setup + rules:
+        parts.append(path.read_text())
+    return "\n".join(parts)

