@@ -16,9 +16,21 @@ at once**:
    that window start. This is the classic exact-match-as-threshold
    formulation: a DFA transition needs a table lookup; an equality test
    is just arithmetic, and arithmetic is what the systolic array does.
-3. **chain**: per-branch gap constraints via shifts, prefix sums
-   (bounded/unbounded any-gaps) and an associative latch scan
-   (single-class gaps like ``\\s*`` / ``[^>]*``) on ``[T, Q]`` bitmaps.
+3. **chain**: gap constraints as bitmap algebra on ``[T, Q, ·]``
+   blocks. Multi-element branches starting with a segment (the common
+   shape: literal token, then gaps/segments) are SUFFIX-DEDUPED: the
+   ops after the first segment evaluate right-to-left once per distinct
+   suffix, and each branch collapses to one AND-any against its first
+   segment's conv column. Cumulative ops (window-ORs for any-gaps, the
+   NCE latch for unbounded class gaps) run as log-shift passes —
+   ``jnp.cumsum``/``lax.cummax`` lower to reduce-window on TPU, which
+   profiled at a quarter of the block's runtime; log2(Q) elementwise
+   passes on a 66-long axis are ~free.
+
+Conv output columns are PERMUTED (and duplicated when shared) at trace
+time so every chain/final/solo consumer reads a contiguous slice of
+``m_all`` — arbitrary channel-list indexing is a minor-axis gather,
+which serializes on TPU and cost ~half the block before the rewrite.
 
 Position space: padded index ``p`` covers a front NUL pad (``p = 0``,
 which makes start-of-input read as a non-word byte for ``\\b``) plus the
@@ -243,6 +255,64 @@ def _rshift3(x: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.pad(x, ((0, 0), (k, 0), (0, 0)))[:, : x.shape[1]]
 
 
+def _lshift3(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Shift left along axis 1 of a [T, Q, NB] array, zero fill:
+    out[:, p] = x[:, p + k]."""
+    if k == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, k), (0, 0)))[:, k:]
+
+
+def _shift3_fill(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
+    """Shift along axis 1 of [T, Q, NB]: out[:, p] = x[:, p + k] (k > 0
+    pulls from the right, k < 0 from the left), filled with ``fill``."""
+    if k == 0:
+        return x
+    if k > 0:
+        return jnp.pad(x, ((0, 0), (0, k), (0, 0)), constant_values=fill)[:, k:]
+    return jnp.pad(x, ((0, 0), (-k, 0), (0, 0)), constant_values=fill)[:, : x.shape[1]]
+
+
+def _spread_or(x: jnp.ndarray, lo: int, hi: int, forward: bool) -> jnp.ndarray:
+    """OR-spread along axis 1: out[p] = ∃d ∈ [lo, hi (or ∞ if hi<0)]:
+    x[p + d] (forward) or x[p - d] (backward). Log-shift passes — TPU
+    has no fast scan lowering (cumsum/cummax become reduce-window), and
+    Q is tiny, so log2(Q) elementwise ORs win."""
+    q = x.shape[1]
+    sgn = 1 if forward else -1
+    if hi < 0:
+        # Unbounded: suffix/prefix OR, then shift by lo.
+        y = x
+        k = 1
+        while k < q:
+            y = y | _shift3_fill(y, sgn * k, False)
+            k *= 2
+        return _shift3_fill(y, sgn * lo, False)
+    width = hi - lo + 1
+    # OR over a window of `width`: doubling windows, then one patch-up.
+    y = x
+    span = 1  # y[p] == OR of x[p .. p + span-1] (direction-adjusted)
+    while span * 2 <= width:
+        y = y | _shift3_fill(y, sgn * span, False)
+        span *= 2
+    if span < width:
+        y = y | _shift3_fill(y, sgn * (width - span), False)
+    return _shift3_fill(y, sgn * lo, False)
+
+
+def _latch_min(vals: jnp.ndarray, big, forward: bool) -> jnp.ndarray:
+    """Running min along axis 1 (suffix-min if forward, prefix-min if
+    backward) via log-shift passes — avoids reduce-window."""
+    q = vals.shape[1]
+    sgn = 1 if forward else -1
+    y = vals
+    k = 1
+    while k < q:
+        y = jnp.minimum(y, _shift3_fill(y, sgn * k, big))
+        k *= 2
+    return y
+
+
 def _lshift_fill(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
     if k == 0:
         return x
@@ -281,44 +351,148 @@ def match_segment_block(
     planes = [_channel_plane(c, dpad) for c in spec.channels]
     embed = jnp.stack(planes, axis=-1).astype(jnp.bfloat16)  # [T, 1+L+W, C]
 
+    # --- static chain program (pure Python at trace time) ---
+    # Two tiers:
+    #
+    # (a) seg-first multi-element branches (the vast majority: literal
+    #     token then gaps/segments) run on the SUFFIX-DEDUPED path: the
+    #     program after the first segment is computed right-to-left ONCE
+    #     per distinct suffix as a [T, Q, NS] bitmap (NS = #distinct
+    #     suffixes, usually ~1), then every branch reduces to ONE
+    #     AND-any over its first segment's m_all column. v2 ran the
+    #     whole 6-op program batched over NB branch columns — ~6 passes
+    #     over an [T, Q, NB] block per bucket; suffix dedup makes the
+    #     per-branch work a single read of its m_all column.
+    #
+    # (b) everything else (solo segments, gap-first branches) keeps the
+    #     signature-bucketed batched program of v2.
+    old_path: list[int] = []
+    chain_first: list[int] = []
+    for bi, (gid, prog, a_start, a_end) in enumerate(spec.branches):
+        if len(prog) >= 2 and prog[0][0] == "seg":
+            chain_first.append(bi)
+        else:
+            old_path.append(bi)
+
+    buckets: dict[tuple, list[int]] = {}
+    for bi in old_path:
+        gid, prog, a_start, a_end = spec.branches[bi]
+        buckets.setdefault(_branch_signature(spec, prog, a_start, a_end), []).append(bi)
+
+    suffix_ids: dict[tuple, int] = {}
+    finals: dict[tuple, list[tuple[int, int]]] = {}
+    for bi in chain_first:
+        gid, prog, a_start, a_end = spec.branches[bi]
+        skey = (prog[1:], a_end)
+        sid = suffix_ids.setdefault(skey, len(suffix_ids))
+        seg_chan = prog[0][1]
+        n_lead, n_real = spec.seg_meta[seg_chan]
+        finals.setdefault((sid, n_lead, n_real, a_start), []).append((bi, seg_chan))
+
+    def _suffix_sig(skey: tuple) -> tuple:
+        ops, a_end = skey
+        sig: list[tuple] = []
+        for el in ops:
+            if el[0] == "seg":
+                nl, nr = spec.seg_meta[el[1]]
+                sig.append(("seg", nl, nr))
+            else:
+                sig.append(el)
+        return (tuple(sig), a_end)
+
+    struct: dict[tuple, list[tuple[tuple, int]]] = {}
+    for skey, sid in suffix_ids.items():
+        struct.setdefault(_suffix_sig(skey), []).append((skey, sid))
+
+    # --- conv column layout ---
+    # Every consumer below reads a CONTIGUOUS slice of the conv output:
+    # arbitrary channel-list indexing is a gather along the minor axis,
+    # which serializes on TPU and was measured at ~half the block's
+    # runtime. Instead the *kernel* columns are permuted (and duplicated
+    # where two consumers share a segment) at trace time — the "gather"
+    # rides the MXU inside the conv, and m_all is born in consumer order.
+    col_order: list[int] = []
+
+    def alloc(chs: list[int]) -> tuple[int, int]:
+        start = len(col_order)
+        col_order.extend(chs)
+        return (start, len(col_order))
+
+    final_alloc = {
+        gk: alloc([c for _, c in items]) for gk, items in finals.items()
+    }
+    struct_alloc: dict[tuple, list[tuple[int, int]]] = {}
+    for sig_key, members in struct.items():
+        chan_cols = [
+            [el[1] for el in skey[0] if el[0] == "seg"] for skey, _ in members
+        ]
+        n_slots = len(chan_cols[0]) if chan_cols else 0
+        struct_alloc[sig_key] = [
+            alloc([cc[slot] for cc in chan_cols]) for slot in range(n_slots)
+        ]
+    bucket_alloc: dict[tuple, list[tuple[int, int]]] = {}
+    for sig_key, idxs in buckets.items():
+        chan_lists = [
+            [el[1] for el in spec.branches[bi][1] if el[0] == "seg"]
+            for bi in idxs
+        ]
+        n_slots = len(chan_lists[0]) if chan_lists else 0
+        bucket_alloc[sig_key] = [
+            alloc([cl[slot] for cl in chan_lists]) for slot in range(n_slots)
+        ]
+    if not col_order:
+        col_order = [0]
+
     # 2. conv: all segments, all start positions. out[t, p, n] == 2W ⇔
     # segment n matches the window starting at padded position p. (An
     # im2col-matmul formulation was measured 1.6x SLOWER here — the
     # [T·Q, W·C] window materialization's HBM traffic exceeds the conv's
     # MXU inefficiency at these channel counts.)
+    kernel_p = kernel[:, :, np.asarray(col_order)]  # [W, C, N2] tiny gather
+    # bf16 accumulation is exact here (integer partial sums ≤ 2W = 34
+    # ≪ 256) and halves the conv-output HBM traffic — the threshold is
+    # fused into each consumer, so every chain stage reads `out`, not a
+    # materialized bool.
     out = jax.lax.conv_general_dilated(
         embed,
-        kernel,
+        kernel_p,
         window_strides=(1,),
         padding="VALID",
         dimension_numbers=("NWC", "WIO", "NWC"),
-        preferred_element_type=jnp.float32,
-    )  # [T, Q, N]
-    m_all = out >= (2.0 * w)  # equality; >= is safe (2W is the max)
+        preferred_element_type=jnp.bfloat16,
+    )  # [T, Q, N2]
+    m_all = out >= jnp.bfloat16(2.0 * w)  # equality; >= is safe (2W is the max)
 
     iota = jnp.arange(q, dtype=jnp.int32)[None, :]  # [1, Q]
     len1 = 1 + lengths[:, None]  # [T, 1] position just past the last byte
     iota3 = iota[..., None]  # [1, Q, 1]
     len3 = len1[..., None]  # [T, 1, 1]
 
-    # 3. chain — branches bucketed by signature, each bucket one batched
-    # program over [T, Q, NB] (v1 ran 1 chain per branch: ~6 ops x
-    # hundreds of branches exploded both compile time and per-op launch
-    # overhead; bucketing collapses it to ~#structures chains).
-    buckets: dict[tuple, list[int]] = {}
-    for bi, (gid, prog, a_start, a_end) in enumerate(spec.branches):
-        buckets.setdefault(_branch_signature(spec, prog, a_start, a_end), []).append(bi)
-
     # Gap-class tables are built eagerly OUTSIDE the cond-gated chains:
     # tracers created inside one cond branch must not be cached and reused
     # inside another trace.
+    #
+    # NCE (count of non-class bytes before p) is itself a prefix sum —
+    # computed as one [Q, Q] triangular matmul, NOT jnp.cumsum: cumulative
+    # ops along a 66-long axis lower to reduce-window on TPU, which
+    # profiled at ~1/4 of this whole block's runtime. Q is tiny, so the
+    # O(Q²) matmul is ~free on the MXU (and exact in bf16: sums ≤ Q ≪
+    # 256). M_cls[t, p', p] = (p' ≥ p ∧ NCE[p'] == NCE[p]) is the
+    # "suffix of p is class-clean through p'" reachability operand used
+    # by unbounded class gaps.
+    tri_excl = jnp.asarray(
+        np.triu(np.ones((q, q), dtype=np.float32), 1), dtype=jnp.bfloat16
+    )  # [p', p]: p' < p
     _tabs_cache: dict[tuple, tuple] = {}
     for _, prog, _, _ in spec.branches:
         for el in prog:
             if el[0] == "gapcls" and el[1] not in _tabs_cache:
                 in_c = _in_class(el[1], dpad)[:, :q]  # byte at p ∈ class
-                non_c = (~in_c).astype(jnp.int32)
-                nce = jnp.cumsum(non_c, axis=1) - non_c  # non-C in [0, p)
+                non_c = (~in_c).astype(jnp.bfloat16)
+                # non-C bytes in [0, p): exclusive prefix sum via matmul.
+                nce = jnp.dot(
+                    non_c, tri_excl, preferred_element_type=jnp.float32
+                ).astype(jnp.int32)
                 _tabs_cache[el[1]] = (in_c, nce)
 
     def gap_cls_tabs(ivs: tuple):
@@ -326,20 +500,51 @@ def match_segment_block(
 
     big = jnp.int32(1 << 20)
 
+    def gap_cls(x: jnp.ndarray, ivs: tuple, lo: int, hi: int, forward: bool):
+        """Class-gap op along axis 1 of [T, Q, NB]. Forward (suffix/RTL):
+        out[p] = ∃d ∈ [lo, hi]: bytes [p, p+d) ∈ C ∧ x[p+d]. Backward
+        (bucket/LTR): out[p'] = ∃d: bytes [p'-d, p') ∈ C ∧ x[p'-d].
+        Unbounded gaps use the NCE latch (monotone non-class counts) as a
+        log-shift running min — lax.cummax/cummin lower to reduce-window
+        on TPU, which profiled at ~1/4 of this block's runtime."""
+        _, nce = gap_cls_tabs(ivs)
+        nce3 = nce[..., None]
+
+        def clean(d: int) -> jnp.ndarray:
+            if d == 0:
+                return jnp.ones((t, q, 1), dtype=bool)
+            return (
+                jnp.pad(nce3, ((0, 0), (0, d), (0, 0)), constant_values=big)[:, d:]
+                - nce3
+            ) == 0
+
+        if hi >= 0:
+            acc = jnp.zeros_like(x)
+            for d in range(lo, hi + 1):
+                if forward:
+                    acc = acc | (_lshift3(x, d) & clean(d))
+                else:
+                    acc = acc | _rshift3(x & clean(d), d)
+            return acc
+        if forward:
+            x1 = _lshift3(x, lo) & clean(lo) if lo else x
+            h = _latch_min(jnp.where(x1, nce3, big), big, forward=True)
+            return h == nce3
+        x1 = _rshift3(x & clean(lo), lo) if lo else x
+        h = -_latch_min(jnp.where(x1, -nce3, big), big, forward=False)
+        return h == nce3
+
     def run_bucket(sig: tuple, idxs: list[int]) -> jnp.ndarray:
         ops, a_start, a_end = sig
-        chan_lists: list[list[int]] = []
-        for gid_prog in idxs:
-            _, prog, _, _ = spec.branches[gid_prog]
-            chans = [el[1] for el in prog if el[0] == "seg"]
-            chan_lists.append(chans)
+        slots = bucket_alloc[sig]
         nb = len(idxs)
 
         # Single-seg unanchored fast path: evaluate at window starts, no
         # shifts at all (start/end constraints as comparisons on j).
         if len(ops) == 1 and ops[0][0] == "seg":
             _, n_lead, n_real = ops[0]
-            m = m_all[:, :, [c[0] for c in chan_lists]]  # [T, Q, NB]
+            a0, a1 = slots[0]
+            m = m_all[:, :, a0:a1]  # [T, Q, NB]
             r = iota3 + n_lead  # real start for window at j
             ok = (r >= 1) & (r + n_real <= len3)
             if a_start:
@@ -355,9 +560,9 @@ def match_segment_block(
             for op in ops:
                 if op[0] == "seg":
                     _, n_lead, n_real = op
-                    chans = [cl[seg_i] for cl in chan_lists]
+                    a0, a1 = slots[seg_i]
                     seg_i += 1
-                    m = m_all[:, :, chans]  # [T, Q, NB]
+                    m = m_all[:, :, a0:a1]  # [T, Q, NB]
                     if n_lead:
                         m = jnp.pad(m, ((0, 0), (n_lead, 0), (0, 0)))[:, :q]
                     valid = (iota3 >= 1) & (iota3 + n_real <= len3)
@@ -365,42 +570,12 @@ def match_segment_block(
                     if n_real:
                         e = jnp.pad(e, ((0, 0), (n_real, 0), (0, 0)))[:, :q]
                 elif op[0] == "gapany":
+                    # e_out[p] = ∃d ∈ [lo, hi]: e[p - d] — log-shift OR.
                     _, lo, hi = op
-                    s = jnp.cumsum(e.astype(jnp.int32), axis=1)
-                    if hi < 0:
-                        e = _rshift3(s, lo) > 0
-                    else:
-                        e = (_rshift3(s, lo) - _rshift3(s, hi + 1)) > 0
+                    e = _spread_or(e, lo, hi, forward=False)
                 else:  # gapcls
                     _, ivs, lo, hi = op
-                    in_c, nce = gap_cls_tabs(ivs)
-                    nce3 = nce[..., None]
-
-                    def clean(d: int, nce3=nce3) -> jnp.ndarray:
-                        if d == 0:
-                            return jnp.ones((t, q, 1), dtype=bool)
-                        return (
-                            jnp.pad(
-                                nce3, ((0, 0), (0, d), (0, 0)), constant_values=big
-                            )[:, d:]
-                            - nce3
-                        ) == 0
-
-                    if hi >= 0:
-                        acc = jnp.zeros_like(e)
-                        for d in range(lo, hi + 1):
-                            acc = acc | _rshift3(e & clean(d), d)
-                        e = acc
-                    else:
-                        e1 = _rshift3(e & clean(lo), lo) if lo else e
-                        # ∃p ≤ q: e1[p] ∧ no non-C byte in [p, q)
-                        #   ⇔ ∃p ≤ q: e1[p] ∧ NCE[p] == NCE[q]  (NCE monotone)
-                        #   ⇔ cummax(e1[p] ? NCE[p] : -1) == NCE[q]
-                        # — one native cummax, not a 7-step custom scan.
-                        h = jax.lax.cummax(
-                            jnp.where(e1, nce3, jnp.int32(-1)), axis=1
-                        )
-                        e = h == nce3
+                    e = gap_cls(e, ivs, lo, hi, forward=False)
             if a_end:
                 return jnp.any(e & (iota3 == len3), axis=1)
             return jnp.any(e & (iota3 <= len3), axis=1)
@@ -409,14 +584,48 @@ def match_segment_block(
         # first segments match NOWHERE in the whole block, no row can match
         # any of its branches — skip the chain entirely. Worst case is
         # unchanged; benign-heavy traffic skips almost every chain.
-        first_chans = [cl[0] for cl in chan_lists if cl]
-        if first_chans:
-            pred = jnp.any(m_all[:, :, first_chans])
+        if slots:
+            a0, a1 = slots[0]
+            pred = jnp.any(m_all[:, :, a0:a1])
             # The no-match branch derives its zeros from m_all so both
             # branches carry the same varying-axes type under shard_map.
             no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, nb))
             return jax.lax.cond(pred, run_chain, lambda _: no_match, None)
         return run_chain(None)
+
+    # --- suffix-deduped tier (a) ---
+    # Right-to-left evaluation, batched over the group's distinct
+    # suffixes: s[t, p, i] = "suffix i fully matches with its first
+    # element's real bytes starting at padded position p".
+    s_store: dict[int, jnp.ndarray] = {}
+    for sig_key, members in struct.items():
+        sig_ops, a_end = sig_key
+        ns = len(members)
+        # Base: "the element AFTER the suffix may start at p" — one past
+        # the last byte for $-anchored branches, anywhere in range else.
+        s = jnp.broadcast_to(
+            (iota3 == len3) if a_end else (iota3 <= len3), (t, q, ns)
+        )
+        seg_slot = sum(1 for o in sig_ops if o[0] == "seg")
+        for op in reversed(sig_ops):
+            if op[0] == "seg":
+                seg_slot -= 1
+                _, n_lead, n_real = op
+                a0, a1 = struct_alloc[sig_key][seg_slot]
+                m = m_all[:, :, a0:a1]  # [T, Q, NS] at window starts
+                if n_lead:
+                    m = _rshift3(m, n_lead)  # index by real start
+                valid = (iota3 >= 1) & (iota3 + n_real <= len3)
+                s = m & valid & _lshift3(s, n_real)
+            elif op[0] == "gapany":
+                # s_k[p] = ∃d ∈ [lo, hi]: s[p + d] — log-shift OR spread.
+                _, lo, hi = op
+                s = _spread_or(s, lo, hi, forward=True)
+            else:  # gapcls
+                _, ivs, lo, hi = op
+                s = gap_cls(s, ivs, lo, hi, forward=True)
+        for i, (_skey, sid) in enumerate(members):
+            s_store[sid] = s[:, :, i]
 
     # Concatenate bucket outputs (bucket order) and map columns to groups
     # with one matmul — no scatter (TPU scatter lowering serializes).
@@ -427,6 +636,32 @@ def match_segment_block(
         for sig, idxs in buckets.items():
             cols.append(run_bucket(sig, idxs))  # [T, len(idxs)]
             col_groups.extend(spec.branches[bi][0] for bi in idxs)
+        iota2 = iota  # [1, Q]
+        for (sid, n_lead, n_real, a_start), items in finals.items():
+            s2 = s_store[sid]  # [T, Q], indexed by real start of the NEXT element
+            g = (
+                (iota2 >= 1)
+                & (iota2 + n_real <= len1)
+                & _lshift_fill(s2, n_real, False)
+            )
+            if a_start:
+                g = g & (iota2 == 1)
+            gj = _lshift_fill(g, n_lead, False)  # index by window start
+            a0, a1 = final_alloc[(sid, n_lead, n_real, a_start)]
+            m = m_all[:, :, a0:a1]  # [T, Q, NB]
+
+            # Prefilter gate (as in the bucketed tier): if none of this
+            # group's first segments matched anywhere in the block, skip
+            # the AND-any reduction entirely — benign-heavy traffic pays
+            # only the cheap any() read.
+            def run_final(_, m=m, gj=gj):
+                return jnp.any(m & gj[:, :, None], axis=1)  # [T, NB]
+
+            no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, a1 - a0))
+            cols.append(
+                jax.lax.cond(jnp.any(m), run_final, lambda _, z=no_match: z, None)
+            )
+            col_groups.extend(spec.branches[bi][0] for bi, _ in items)
         bh_all = jnp.concatenate(cols, axis=1)
         b2g = np.zeros((len(col_groups), spec.n_groups), dtype=np.float32)
         for ci, gid in enumerate(col_groups):
