@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from ..compiler.ruleset import CompiledRuleSet
 from ..engine.request import HttpRequest
 from ..engine.waf import WafEngine
 from ..observability.audit import AuditLogger, AuditRecord
@@ -236,11 +237,12 @@ class FtwRunner:
 
 def run_corpus(
     corpus_dir: str | Path,
-    rules: str,
+    rules: str | CompiledRuleSet,
     overrides_path: str | Path | None = None,
 ) -> FtwResult:
-    """Convenience: compile ``rules``, load every test under ``corpus_dir``
-    and replay in-process honoring the ledger."""
+    """Convenience: compile ``rules`` (Seclang text, or an already
+    compiled ruleset to reuse a shared compile), load every test under
+    ``corpus_dir`` and replay in-process honoring the ledger."""
     overrides = load_overrides(overrides_path) if overrides_path else {}
     runner = FtwRunner(engine=WafEngine(rules), overrides=overrides)
     tests, skipped = load_tests_report(corpus_dir)

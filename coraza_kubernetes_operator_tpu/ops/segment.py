@@ -280,6 +280,7 @@ def _spread_or(x: jnp.ndarray, lo: int, hi: int, forward: bool) -> jnp.ndarray:
     Q is tiny, so log2(Q) elementwise ORs win."""
     q = x.shape[1]
     sgn = 1 if forward else -1
+    assert hi < 0 or hi >= lo, f"empty gap range [{lo}, {hi}]"
     if hi < 0:
         # Unbounded: suffix/prefix OR, then shift by lo.
         y = x

@@ -23,3 +23,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent XLA compilation cache: the suite is ~95% XLA:CPU compile
+# time (every engine fixture jits a fresh model), and the cache keys on
+# HLO hash, so re-runs of an unchanged compiler produce byte-identical
+# HLO and skip compilation entirely. First run warms it (~10 min);
+# subsequent runs finish in ~1-2 min. Kept under tests/ so `git clean`
+# or a compiler change naturally invalidates it.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -13,23 +13,28 @@ from coraza_kubernetes_operator_tpu.ftw.runner import run_corpus
 CORPUS = Path(__file__).resolve().parents[1] / "ftw" / "tests-crs-lite"
 
 
-def test_crs_lite_compiles_fully():
-    crs = compile_rules(load_ruleset_text())
+@pytest.fixture(scope="module")
+def crs():
+    """One shared compile: compile_rules on crs-lite is ~30s of host
+    work, and three tests need the same artifact."""
+    return compile_rules(load_ruleset_text())
+
+
+def test_crs_lite_compiles_fully(crs):
     assert crs.n_rules >= 40
     # >=95% of rules compiled (VERDICT's compile-rate bar); every skip
     # must carry a reason.
     assert len(crs.report.skipped) <= crs.n_rules * 0.05, crs.report.skipped
 
 
-def test_crs_lite_uses_data_files():
-    crs = compile_rules(load_ruleset_text())
+def test_crs_lite_uses_data_files(crs):
     assert (CRS_LITE_DIR / "data" / "lfi-os-files.data").exists()
     # pmFromFile rules made it into groups (not skipped).
     assert not any("pmFromFile" in r for _, r in crs.report.skipped)
 
 
-def test_crs_lite_corpus_green():
-    result = run_corpus(CORPUS, load_ruleset_text())
+def test_crs_lite_corpus_green(crs):
+    result = run_corpus(CORPUS, crs)
     summary = result.summary()
     assert summary["passed"] >= 55, summary
     assert result.ok, summary
