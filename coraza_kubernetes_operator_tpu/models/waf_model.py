@@ -45,6 +45,22 @@ from ..ops.transforms import apply_device_pipeline
 
 _BIG = jnp.int32(2**31 - 1)
 
+# Conv-tier match-bitmap element budget (T * (L+2) * N). The default
+# (2^30 ≈ 1.07e9 elements ≈ 3 GB across the bf16 scores + bool bitmap)
+# admits the serving shape 16384 targets x 64 bytes x ~800 segments;
+# long-body buckets beyond it fall back to the DFA scan tier. Setting
+# CKO_SEG_BITMAP_ELEMENTS=0 disables the fallback entirely (no long
+# banks are built — saves their HBM if length buckets are known-small).
+import os as _os
+
+_SEG_BITMAP_ELEMS = int(_os.environ.get("CKO_SEG_BITMAP_ELEMENTS", str(2**30)))
+
+
+def _state_bucket(n_states: int) -> int:
+    """Padded state-count bucket for bank stacking (shared by the DFA
+    tier, the long-buffer fallback, and the rule-sharded layout)."""
+    return next(b for b in _STATE_BUCKETS if n_states <= b)
+
 # Size buckets for DFA banks (n_states ceiling): groups whose tables fit the
 # same bucket share one padded bank — bounded padding waste, few fused scans.
 _STATE_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
@@ -85,9 +101,17 @@ class WafModel:
     # counters
     weights: jnp.ndarray  # [Rr, C]
     counter_base: jnp.ndarray  # [C]
+    # Long-buffer fallback: the conv tier materializes a [T, Q, N] match
+    # bitmap, which is linear in buffer length — a long-body shape bucket
+    # would OOM. Every segment-routed group also keeps its DFA stacked in
+    # these banks; eval_waf picks the tier per TRACE (shapes are static),
+    # so long buckets stream through the constant-memory scan carry.
+    long_banks: list = field(default_factory=list)
+    seg_perm: jnp.ndarray | None = None  # [Gs, Gs] one-hot: long order → seg order
     # static metadata
     bank_pipelines: tuple = field(default_factory=tuple)  # pipeline id per bank
     seg_pipelines: tuple = field(default_factory=tuple)  # pipeline id per seg block
+    long_bank_pipelines: tuple = field(default_factory=tuple)
     pipelines: tuple = field(default_factory=tuple)  # names per pipeline id
     pipeline_device: tuple = field(default_factory=tuple)
     host_variant_index: tuple = field(default_factory=tuple)  # pid -> variant slot (-1 device)
@@ -118,10 +142,13 @@ class WafModel:
             self.phase,
             self.weights,
             self.counter_base,
+            self.long_banks,
+            self.seg_perm,
         )
         aux = (
             self.bank_pipelines,
             self.seg_pipelines,
+            self.long_bank_pipelines,
             self.pipelines,
             self.pipeline_device,
             self.host_variant_index,
@@ -169,9 +196,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         if plan is not None:
             seg_groups.setdefault(pid, []).append((gid, plan))
             continue
-        s = grp.dfa.n_states
-        bucket = next(b for b in _STATE_BUCKETS if s <= b)
-        buckets.setdefault((pid, bucket), []).append(gid)
+        buckets.setdefault((pid, _state_bucket(grp.dfa.n_states)), []).append(gid)
 
     remap = np.zeros(max(1, len(crs.groups)), dtype=np.int64)
     next_new = 0
@@ -193,6 +218,32 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         for g in gids:
             remap[g] = next_new
             next_new += 1
+
+    # Long-buffer fallback banks: every segment-routed group's DFA,
+    # bucketed by state count like the normal banks. Their concatenated
+    # column order differs from the seg-column order, so seg_perm maps
+    # it back with one one-hot matmul (a minor-axis gather would
+    # serialize on TPU).
+    long_banks: list[DFABank] = []
+    long_bank_pipelines: list[int] = []
+    long_order: list[int] = []
+    if _SEG_BITMAP_ELEMS > 0:  # 0 = fallback disabled, skip the HBM cost
+        long_buckets: dict[tuple[int, int], list[int]] = {}
+        for pid in sorted(seg_groups):
+            for gid, _plan in seg_groups[pid]:
+                key = (pid, _state_bucket(crs.groups[gid].dfa.n_states))
+                long_buckets.setdefault(key, []).append(gid)
+        for (pid, _bucket), gids in sorted(long_buckets.items()):
+            long_banks.append(stack_dfas([crs.groups[g].dfa for g in gids]))
+            long_bank_pipelines.append(pid)
+            long_order.extend(gids)
+    n_seg_groups = sum(len(v) for v in seg_groups.values())
+    seg_perm = None
+    if long_order:
+        perm = np.zeros((len(long_order), n_seg_groups), dtype=np.int8)
+        for j, gid in enumerate(long_order):
+            perm[j, remap[gid]] = 1  # seg groups hold remap ids [0, Gs)
+        seg_perm = jnp.asarray(perm)
 
     # Host pipeline variant slots.
     host_variant_index = []
@@ -286,8 +337,11 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         counter_base=jnp.asarray(
             crs.counter_base if crs.counter_base.size else np.zeros(1, np.int32)
         ),
+        long_banks=long_banks,
+        seg_perm=seg_perm,
         bank_pipelines=tuple(bank_pipelines),
         seg_pipelines=tuple(seg_pipelines),
+        long_bank_pipelines=tuple(long_bank_pipelines),
         pipelines=tuple(tuple(p) for p in crs.pipelines),
         pipeline_device=tuple(crs.pipeline_device),
         host_variant_index=tuple(host_variant_index),
@@ -340,9 +394,32 @@ def eval_waf(
                 )
         return transformed[pid]
 
-    for seg, pid in zip(model.segs, model.seg_pipelines):
-        tdata, tlen = transformed_for(pid)
-        per_block.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
+    # Tier choice per TRACE (shapes are static per bucket): the conv tier
+    # materializes ~[T, L+2, N] match-bitmap elements, linear in buffer
+    # length — beyond the budget a long-body bucket streams through the
+    # constant-memory DFA scan carry instead (same groups, same columns
+    # after seg_perm).
+    n_seg_cols = sum(int(s.kernel.shape[2]) for s in model.segs)
+    bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
+    use_long = bool(model.long_banks) and bitmap_elems > _SEG_BITMAP_ELEMS
+    if use_long:
+        long_cols: list[jnp.ndarray] = []
+        for bank, pid in zip(model.long_banks, model.long_bank_pipelines):
+            tdata, tlen = transformed_for(pid)
+            long_cols.append(scan_dfa_bank(bank, tdata, tlen))
+        lh = jnp.concatenate(long_cols, axis=1)  # [T, Gs] in long order
+        per_block.append(
+            jnp.dot(
+                lh.astype(jnp.bfloat16),
+                model.seg_perm.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )  # [T, Gs] in seg-column order
+    else:
+        for seg, pid in zip(model.segs, model.seg_pipelines):
+            tdata, tlen = transformed_for(pid)
+            per_block.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
     for bank, pid in zip(model.banks, model.bank_pipelines):
         tdata, tlen = transformed_for(pid)
         per_block.append(scan_dfa_bank(bank, tdata, tlen))

@@ -153,9 +153,16 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
         lgroup[i] = remap[link.group] if link.group >= 0 else 0
     e_lg = lgroup_onehot(lgroup, max(1, offset))
 
+    # The long-buffer fallback banks are replicated (like the seg tier):
+    # they ride inside `post` so the shard_map body can reach them. Their
+    # columns land in seg order via seg_perm — identical leading layout
+    # in both the single-chip and gathered group orders (segs first).
     post = WafModel(
         banks=[],
         segs=[],
+        long_banks=base.long_banks,
+        seg_perm=base.seg_perm,
+        long_bank_pipelines=base.long_bank_pipelines,
         ltype=base.ltype,
         lneg=base.lneg,
         lgroup=jnp.asarray(lgroup),
@@ -231,11 +238,36 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
                     )
             return transformed[pid]
 
-        # Segment tier: replicated (identical on every rule shard).
+        # Segment tier: replicated (identical on every rule shard). Long
+        # shape buckets take the constant-memory DFA fallback exactly as
+        # the single-chip path does (models/waf_model.py tier routing) —
+        # the budget is per device, so the per-shard shape is the right
+        # operand.
+        from ..models.waf_model import _SEG_BITMAP_ELEMS
+
+        n_seg_cols = sum(int(s.kernel.shape[2]) for s in segs)
+        bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
+        use_long = bool(post.long_banks) and (
+            _SEG_BITMAP_ELEMS > 0 and bitmap_elems > _SEG_BITMAP_ELEMS
+        )
         seg_cols = []
-        for seg, pid in zip(segs, model.seg_pipelines):
-            tdata, tlen = transformed_for(pid)
-            seg_cols.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
+        if use_long:
+            long_cols = []
+            for bank, pid in zip(post.long_banks, model.post.long_bank_pipelines):
+                long_cols.append(scan_dfa_bank(bank, *transformed_for(pid)))
+            lh = jnp.concatenate(long_cols, axis=1)
+            seg_cols.append(
+                jnp.dot(
+                    lh.astype(jnp.bfloat16),
+                    post.seg_perm.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0
+            )
+        else:
+            for seg, pid in zip(segs, model.seg_pipelines):
+                tdata, tlen = transformed_for(pid)
+                seg_cols.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
 
         per_bucket = []
         for bank, pid in zip(banks, model.bank_pipelines):

@@ -71,3 +71,42 @@ def test_sharded_matches_single(shape):
 def test_mesh_device_requirements():
     with pytest.raises(ValueError):
         make_mesh(1000, 1000)
+
+
+def test_sharded_long_body_fallback(monkeypatch):
+    """The rule-sharded path must take the same constant-memory DFA
+    fallback for long shape buckets as the single-chip path (the conv
+    bitmap is per-device, so the budget applies per shard)."""
+    import jax as _jax
+
+    from coraza_kubernetes_operator_tpu.models import waf_model
+
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough devices")
+    rules = (
+        "SecRuleEngine On\nSecRequestBodyAccess On\n"
+        'SecRule ARGS "@rx (?i:\\bunion\\s+select\\b)" "id:1,phase:2,deny,status:403,t:none,t:urlDecodeUni"\n'
+        'SecRule ARGS "@contains evilmonkey" "id:2,phase:2,deny,status:403,t:none"\n'
+    )
+    filler = "z" * 400
+    reqs = [
+        HttpRequest(uri=f"/?q={filler}+union+select+a+from+b"),
+        HttpRequest(uri=f"/?q={filler}+benign"),
+        HttpRequest(uri=f"/?q={filler}+evilmonkey"),
+        HttpRequest(uri="/short"),
+    ]
+    compiled = compile_rules(rules)
+    single = WafEngine(compiled)
+    expected = single.evaluate(reqs)
+
+    monkeypatch.setattr(waf_model, "_SEG_BITMAP_ELEMS", 1)  # force long tier
+    _jax.clear_caches()
+    try:
+        sharded = ShardedWafEngine(compiled=compiled, mesh=make_mesh(2, 1))
+        got = sharded.evaluate(reqs)
+        for i, (e, g) in enumerate(zip(expected, got)):
+            assert g.interrupted == e.interrupted, i
+            assert g.status == e.status, i
+            assert g.rule_id == e.rule_id, i
+    finally:
+        _jax.clear_caches()  # drop long-tier executables traced under the tiny budget
