@@ -16,11 +16,18 @@ CRS_LITE_DIR = _HERE / "crs-lite"
 
 def load_ruleset_text(root: str | Path = CRS_LITE_DIR) -> str:
     """Concatenate a CRS-layout rules directory: ``crs-setup.conf`` (and
-    any other non-REQUEST config) first, then the rule files in CRS
-    order; SecDataDir pinned to the corpus ``data/`` directory."""
+    any other non-rule config) first, then REQUEST-*/RESPONSE-* rule
+    files in CRS order (request families, then response families, as
+    the numeric prefixes already encode); SecDataDir pinned to the
+    corpus ``data/`` directory."""
     root = Path(root)
-    setup = sorted(p for p in root.glob("*.conf") if not p.name.startswith("REQUEST-"))
-    rules = sorted(p for p in root.glob("*.conf") if p.name.startswith("REQUEST-"))
+
+    def is_rule_file(p: Path) -> bool:
+        return p.name.startswith(("REQUEST-", "RESPONSE-"))
+
+    setup = sorted(p for p in root.glob("*.conf") if not is_rule_file(p))
+    rules = sorted((p for p in root.glob("*.conf") if is_rule_file(p)),
+                   key=lambda p: p.name.split("-", 2)[1])
     parts = [f"SecDataDir {root / 'data'}"]
     for path in setup + rules:
         parts.append(path.read_text())

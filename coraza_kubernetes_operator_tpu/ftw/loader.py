@@ -31,6 +31,14 @@ class FtwStage:
     version: str = "HTTP/1.1"
     headers: list[tuple[str, str]] = field(default_factory=list)
     data: bytes = b""
+    # First-party extension for response phases 3/4: go-ftw can only
+    # observe responses produced by a real backend, so CRS response-rule
+    # tests normally need a live echo server. In-process we inject the
+    # upstream response directly: `input.response: {status, headers,
+    # data}`. None = request-only stage (standard go-ftw semantics).
+    response_status: int | None = None
+    response_headers: list[tuple[str, str]] = field(default_factory=list)
+    response_data: bytes = b""
     # assertions
     status: list[int] = field(default_factory=list)
     expect_ids: list[int] = field(default_factory=list)
@@ -87,12 +95,24 @@ def _parse_stage(obj: dict, source: str) -> FtwStage:
     expect_ids = [int(x) for x in (log.get("expect_ids") or [])]
     no_expect_ids = [int(x) for x in (log.get("no_expect_ids") or [])]
 
+    resp = inp.get("response") or {}
+    resp_headers = resp.get("headers", {}) or {}
+    if isinstance(resp_headers, dict):
+        resp_header_list = [(str(k), str(v)) for k, v in resp_headers.items()]
+    else:
+        resp_header_list = [(str(k), str(v)) for k, v in resp_headers]
+
     return FtwStage(
         method=str(inp.get("method", "GET")),
         uri=str(inp.get("uri", "/")),
         version=str(inp.get("version", "HTTP/1.1")),
         headers=header_list,
         data=_as_bytes(inp.get("data")),
+        response_status=int(resp["status"]) if "status" in resp else (
+            200 if resp else None
+        ),
+        response_headers=resp_header_list,
+        response_data=_as_bytes(resp.get("data")),
         status=status,
         expect_ids=expect_ids,
         no_expect_ids=no_expect_ids,

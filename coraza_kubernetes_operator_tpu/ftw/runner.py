@@ -134,7 +134,29 @@ class FtwRunner:
     def _run_stage_inproc(self, stage: FtwStage) -> tuple[int, list[str]]:
         assert self.engine is not None
         req = _stage_request(stage)
-        verdict = self.engine.evaluate_one(req)
+        if stage.response_status is not None:
+            # Response-phase stage (loader extension): the request phases
+            # run first (an interrupted request never reaches upstream);
+            # survivors evaluate phases 3/4 against the injected upstream
+            # response. Observed status: request verdict if interrupted,
+            # else response verdict if interrupted, else the upstream
+            # status passes through.
+            from ..engine.request import HttpResponse
+
+            verdict = self.engine.evaluate_one(req)
+            if not verdict.interrupted:
+                verdict = self.engine.evaluate_response(
+                    req,
+                    HttpResponse(
+                        status=stage.response_status,
+                        headers=list(stage.response_headers),
+                        body=stage.response_data,
+                    ),
+                )
+            passthrough = stage.response_status
+        else:
+            verdict = self.engine.evaluate_one(req)
+            passthrough = 200
         buf = io.StringIO()
         logger = AuditLogger(stream=buf, relevant_only=False)
         meta = self.engine.rule_meta
@@ -147,7 +169,7 @@ class FtwRunner:
                 matched=[meta.get(r, {"id": r}) for r in verdict.matched_ids],
             )
         )
-        status = verdict.status if verdict.interrupted else 200
+        status = verdict.status if verdict.interrupted else passthrough
         return status, buf.getvalue().splitlines()
 
     def _run_stage_http(self, stage: FtwStage) -> tuple[int | None, list[str]]:
@@ -215,10 +237,20 @@ class FtwRunner:
                 result.ignored[test.title] = self.overrides[test.title]
                 continue
             failure = None
+            ignored_reason = None
             for i, stage in enumerate(test.stages):
                 if self.engine is not None:
                     status, lines = self._run_stage_inproc(stage)
                 else:
+                    if stage.response_status is not None:
+                        # Response injection needs the in-process engine;
+                        # a live backend produces its own responses, so
+                        # running the request alone would assert nothing
+                        # about the response rules (or pass vacuously).
+                        ignored_reason = (
+                            "response-injection stage requires in-process mode"
+                        )
+                        break
                     status, lines = self._run_stage_http(stage)
                 if status is None:
                     failure = f"stage {i}: transport failure (target unreachable)"
@@ -227,7 +259,9 @@ class FtwRunner:
                 if not outcome.passed:
                     failure = f"stage {i}: {outcome.reason}"
                     break
-            if failure is None:
+            if ignored_reason is not None:
+                result.ignored[test.title] = ignored_reason
+            elif failure is None:
                 result.passed.append(test.title)
             else:
                 result.failed[test.title] = failure

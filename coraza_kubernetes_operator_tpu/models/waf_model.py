@@ -350,6 +350,50 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
     )
 
 
+def segment_tier_hits(
+    segs,
+    seg_pipelines,
+    long_banks,
+    long_bank_pipelines,
+    seg_perm,
+    data: jnp.ndarray,
+    transformed_for,
+) -> list:
+    """Hit blocks for the segment-routed groups, choosing the tier per
+    TRACE (shapes are static per bucket): the conv tier materializes
+    ~[T, L+2, N] match-bitmap elements — linear in buffer length — so
+    beyond the budget a long-body bucket streams through the
+    constant-memory DFA scan carry instead (same groups, same column
+    order after ``seg_perm``). Shared by the single-chip ``eval_waf``
+    and the rule-sharded path (``parallel/mesh.py``)."""
+    from ..ops.dfa import scan_dfa_bank
+    from ..ops.segment import match_segment_block
+
+    n_seg_cols = sum(int(s.kernel.shape[2]) for s in segs)
+    bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
+    use_long = bool(long_banks) and (
+        _SEG_BITMAP_ELEMS > 0 and bitmap_elems > _SEG_BITMAP_ELEMS
+    )
+    if use_long:
+        long_cols = [
+            scan_dfa_bank(bank, *transformed_for(pid))
+            for bank, pid in zip(long_banks, long_bank_pipelines)
+        ]
+        lh = jnp.concatenate(long_cols, axis=1)  # [T, Gs] in long order
+        return [
+            jnp.dot(
+                lh.astype(jnp.bfloat16),
+                seg_perm.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        ]  # [T, Gs] in seg-column order
+    return [
+        match_segment_block(seg.kernel, seg.spec, *transformed_for(pid))
+        for seg, pid in zip(segs, seg_pipelines)
+    ]
+
+
 def _compare(cmp: jnp.ndarray, left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
     """Vectorized six-way comparison (codes from operators.CMP_CODES)."""
     return jnp.select(
@@ -394,32 +438,17 @@ def eval_waf(
                 )
         return transformed[pid]
 
-    # Tier choice per TRACE (shapes are static per bucket): the conv tier
-    # materializes ~[T, L+2, N] match-bitmap elements, linear in buffer
-    # length — beyond the budget a long-body bucket streams through the
-    # constant-memory DFA scan carry instead (same groups, same columns
-    # after seg_perm).
-    n_seg_cols = sum(int(s.kernel.shape[2]) for s in model.segs)
-    bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
-    use_long = bool(model.long_banks) and bitmap_elems > _SEG_BITMAP_ELEMS
-    if use_long:
-        long_cols: list[jnp.ndarray] = []
-        for bank, pid in zip(model.long_banks, model.long_bank_pipelines):
-            tdata, tlen = transformed_for(pid)
-            long_cols.append(scan_dfa_bank(bank, tdata, tlen))
-        lh = jnp.concatenate(long_cols, axis=1)  # [T, Gs] in long order
-        per_block.append(
-            jnp.dot(
-                lh.astype(jnp.bfloat16),
-                model.seg_perm.astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32,
-            )
-            > 0
-        )  # [T, Gs] in seg-column order
-    else:
-        for seg, pid in zip(model.segs, model.seg_pipelines):
-            tdata, tlen = transformed_for(pid)
-            per_block.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
+    per_block.extend(
+        segment_tier_hits(
+            model.segs,
+            model.seg_pipelines,
+            model.long_banks,
+            model.long_bank_pipelines,
+            model.seg_perm,
+            data,
+            transformed_for,
+        )
+    )
     for bank, pid in zip(model.banks, model.bank_pipelines):
         tdata, tlen = transformed_for(pid)
         per_block.append(scan_dfa_bank(bank, tdata, tlen))

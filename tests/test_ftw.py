@@ -144,3 +144,22 @@ def test_corpus_http_against_sidecar(tmp_path, engine):
         assert result.ok, result.summary()
     finally:
         side.stop()
+
+
+def test_http_mode_ignores_response_injection_stages():
+    """Response-injection stages can't run against a live backend (it
+    produces its own responses) — HTTP mode must report them ignored,
+    not run the request alone and assert vacuously."""
+    from coraza_kubernetes_operator_tpu.ftw.loader import FtwStage, FtwTest
+    from coraza_kubernetes_operator_tpu.ftw.runner import FtwRunner
+
+    runner = FtwRunner(base_url="http://127.0.0.1:1")  # never contacted
+    test = FtwTest(
+        title="950100-1",
+        rule_id=950100,
+        stages=[FtwStage(uri="/x", response_status=500, status=[403])],
+    )
+    result = runner.run([test])
+    assert result.passed == [] and not result.failed
+    assert "950100-1" in result.ignored
+    assert "in-process" in result.ignored["950100-1"]
