@@ -1,0 +1,84 @@
+"""Capture a jax.profiler device trace of the serving hot path and print
+an op-level time breakdown (VERDICT §5 tracing item; the reference leans
+on pprof/torch-profiler — this is the XLA-native equivalent).
+
+Usage:
+    python benchmarks/trace_capture.py [--rules 800] [--batch 4096]
+        [--iters 3] [--out /tmp/cko-trace]
+
+Writes the raw xplane trace under --out (open with xprof / tensorboard
+profile plugin) and prints the top ops by device time, so kernel work
+can be attributed without any external tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def op_breakdown(trace_dir: str, iters: int, top: int = 20) -> list[tuple[float, int, str]]:
+    """Parse the xplane op profile into (ms_per_iter, depth, name) rows."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    files = glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb")
+    if not files:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data, _ = rtd.xspace_to_tool_data(files, "op_profile", {})
+    doc = json.loads(data)
+
+    rows: list[tuple[float, int, str]] = []
+
+    def walk(node, depth=0):
+        metrics = node.get("metrics", {})
+        t = metrics.get("rawTime", 0) or metrics.get("time", 0)
+        if depth <= 3 and t:
+            rows.append((t / iters / 1e9, depth, node.get("name", "")))
+        for child in node.get("children", []):
+            walk(child, depth + 1)
+
+    walk(doc.get("byProgram", doc))
+    return sorted(rows, reverse=True)[:top]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=800)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--out", default="/tmp/cko-trace")
+    args = ap.parse_args()
+
+    import jax
+
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs, synthetic_requests
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf
+
+    engine = WafEngine(synthetic_crs(args.rules))
+    extractions = [
+        engine.extractor.extract(r)
+        for r in synthetic_requests(args.batch, attack_ratio=0.1, seed=1)
+    ]
+    dev = [jax.device_put(t) for t in engine._tensorize(extractions)]
+    out = eval_waf(engine.model, *dev)
+    jax.block_until_ready(out["interrupted"])  # compile outside the trace
+
+    jax.profiler.start_trace(args.out)
+    for _ in range(args.iters):
+        out = eval_waf(engine.model, *dev)
+    jax.block_until_ready(out["interrupted"])
+    jax.profiler.stop_trace()
+
+    print(f"trace written to {args.out}")
+    for ms, depth, name in op_breakdown(args.out, args.iters):
+        print(f"{'  ' * depth}{name}: {ms:.2f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -215,7 +215,7 @@ def serialize_requests(requests: list[HttpRequest]) -> bytes:
 
 
 # Shape bucketing must stay bit-for-bit identical to the Python path.
-from ..engine.waf import _MIN_LEN, _bucket  # noqa: E402
+from ..engine.waf import _MIN_LEN, _bucket, _bucket_rows  # noqa: E402
 
 
 class NativeTensorizer:
@@ -251,7 +251,7 @@ class NativeTensorizer:
             n_rows = self._lib.cko_result_rows(res)
             max_len = self._lib.cko_result_maxlen(res)
             n_req = _bucket(max(1, len(requests)))
-            t = _bucket(max(1, n_rows))
+            t = _bucket_rows(max(1, n_rows))
             length = _bucket(max(_MIN_LEN, max_len))
             h = max(1, self._n_host)
 

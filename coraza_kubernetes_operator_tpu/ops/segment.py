@@ -52,6 +52,29 @@ import numpy as np
 from ..compiler.re_parser import ALL_BYTES
 from ..compiler.segments import Branch, Gap, Seg, SegmentPlan
 
+import os as _os
+
+# Experimental fused Pallas finals tier (ops/segment_pallas.py),
+# DISABLED by default: the kernel itself beats the XLA conv + AND-any
+# read (6.4 ms vs ~7.8 ms at serving shapes), but the im2col patches it
+# needs cost ~27 ms to build in XLA — lane-unaligned C=26 channel
+# concats relayout catastrophically, and Mosaic rejects the same concat
+# in VMEM. Net: the XLA conv path wins at these channel counts. The
+# kernel stays correct (interpret-mode differential test) and can be
+# enabled with CKO_PALLAS_FINALS=1 for rulesets with lane-aligned
+# channel counts where the economics flip.
+_PALLAS_FINALS = _os.environ.get("CKO_PALLAS_FINALS", "0") == "1"
+_FINALS_BLOCK_T = 32
+
+
+def _use_pallas_finals(t: int, n_cols: int) -> bool:
+    return (
+        _PALLAS_FINALS
+        and t % _FINALS_BLOCK_T == 0
+        and n_cols >= 128
+        and jax.default_backend() == "tpu"
+    )
+
 # ---------------------------------------------------------------------------
 # Host-side build: plans → channel/kernel spec
 # ---------------------------------------------------------------------------
@@ -444,11 +467,20 @@ def match_segment_block(
     if not col_order:
         col_order = [0]
 
+    # Finals columns go to the fused Pallas tier when eligible (TPU,
+    # tile-divisible batch): they are then EXCLUDED from the XLA conv —
+    # the Pallas kernel computes them itself with a K = W*C im2col
+    # matmul, so m_all below covers only columns [off, N2).
+    n_finals_cols = sum(len(items) for items in finals.values())
+    pallas_finals = n_finals_cols > 0 and _use_pallas_finals(t, n_finals_cols)
+    off = n_finals_cols if pallas_finals else 0
+
     # 2. conv: all segments, all start positions. out[t, p, n] == 2W ⇔
     # segment n matches the window starting at padded position p. (An
-    # im2col-matmul formulation was measured 1.6x SLOWER here — the
-    # [T·Q, W·C] window materialization's HBM traffic exceeds the conv's
-    # MXU inefficiency at these channel counts.)
+    # im2col-matmul formulation was measured 1.6x SLOWER here at XLA
+    # level — the [T·Q, W·C] window materialization's HBM traffic
+    # exceeds the conv's MXU inefficiency; the Pallas finals tier gets
+    # the same K without the HBM cost by building windows in VMEM.)
     kernel_p = kernel[:, :, np.asarray(col_order)]  # [W, C, N2] tiny gather
     # bf16 accumulation is exact here (integer partial sums ≤ 2W = 34
     # ≪ 256) and halves the conv-output HBM traffic — the threshold is
@@ -456,13 +488,17 @@ def match_segment_block(
     # materialized bool.
     out = jax.lax.conv_general_dilated(
         embed,
-        kernel_p,
+        kernel_p[:, :, off:] if off else kernel_p,
         window_strides=(1,),
         padding="VALID",
         dimension_numbers=("NWC", "WIO", "NWC"),
         preferred_element_type=jnp.bfloat16,
-    )  # [T, Q, N2]
+    )  # [T, Q, N2 - off]
     m_all = out >= jnp.bfloat16(2.0 * w)  # equality; >= is safe (2W is the max)
+
+    def mslice(a0: int, a1: int) -> jnp.ndarray:
+        """Columns [a0, a1) of the global allocation, off-adjusted."""
+        return m_all[:, :, a0 - off : a1 - off]
 
     iota = jnp.arange(q, dtype=jnp.int32)[None, :]  # [1, Q]
     len1 = 1 + lengths[:, None]  # [T, 1] position just past the last byte
@@ -545,7 +581,7 @@ def match_segment_block(
         if len(ops) == 1 and ops[0][0] == "seg":
             _, n_lead, n_real = ops[0]
             a0, a1 = slots[0]
-            m = m_all[:, :, a0:a1]  # [T, Q, NB]
+            m = mslice(a0, a1)  # [T, Q, NB]
             r = iota3 + n_lead  # real start for window at j
             ok = (r >= 1) & (r + n_real <= len3)
             if a_start:
@@ -563,7 +599,7 @@ def match_segment_block(
                     _, n_lead, n_real = op
                     a0, a1 = slots[seg_i]
                     seg_i += 1
-                    m = m_all[:, :, a0:a1]  # [T, Q, NB]
+                    m = mslice(a0, a1)  # [T, Q, NB]
                     if n_lead:
                         m = jnp.pad(m, ((0, 0), (n_lead, 0), (0, 0)))[:, :q]
                     valid = (iota3 >= 1) & (iota3 + n_real <= len3)
@@ -587,7 +623,7 @@ def match_segment_block(
         # unchanged; benign-heavy traffic skips almost every chain.
         if slots:
             a0, a1 = slots[0]
-            pred = jnp.any(m_all[:, :, a0:a1])
+            pred = jnp.any(mslice(a0, a1))
             # The no-match branch derives its zeros from m_all so both
             # branches carry the same varying-axes type under shard_map.
             no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, nb))
@@ -613,7 +649,7 @@ def match_segment_block(
                 seg_slot -= 1
                 _, n_lead, n_real = op
                 a0, a1 = struct_alloc[sig_key][seg_slot]
-                m = m_all[:, :, a0:a1]  # [T, Q, NS] at window starts
+                m = mslice(a0, a1)  # [T, Q, NS] at window starts
                 if n_lead:
                     m = _rshift3(m, n_lead)  # index by real start
                 valid = (iota3 >= 1) & (iota3 + n_real <= len3)
@@ -638,6 +674,7 @@ def match_segment_block(
             cols.append(run_bucket(sig, idxs))  # [T, len(idxs)]
             col_groups.extend(spec.branches[bi][0] for bi in idxs)
         iota2 = iota  # [1, Q]
+        gj_per_group: list[jnp.ndarray] = []
         for (sid, n_lead, n_real, a_start), items in finals.items():
             s2 = s_store[sid]  # [T, Q], indexed by real start of the NEXT element
             g = (
@@ -647,21 +684,43 @@ def match_segment_block(
             )
             if a_start:
                 g = g & (iota2 == 1)
-            gj = _lshift_fill(g, n_lead, False)  # index by window start
-            a0, a1 = final_alloc[(sid, n_lead, n_real, a_start)]
-            m = m_all[:, :, a0:a1]  # [T, Q, NB]
+            gj_per_group.append(_lshift_fill(g, n_lead, False))  # window-start idx
 
-            # Prefilter gate (as in the bucketed tier): if none of this
-            # group's first segments matched anywhere in the block, skip
-            # the AND-any reduction entirely — benign-heavy traffic pays
-            # only the cheap any() read.
-            def run_final(_, m=m, gj=gj):
-                return jnp.any(m & gj[:, :, None], axis=1)  # [T, NB]
+        # NOTE: reuses the pallas_finals decision computed before the conv
+        # — the conv's column exclusion (`off`) and this dispatch MUST
+        # agree or mslice() would read shifted columns.
+        if pallas_finals:
+            # Fused Pallas tier: im2col matmul (K = W*C, near MXU peak) +
+            # threshold + reachability-AND + Q-reduce per VMEM tile — the
+            # [T, Q, N] finals bitmap never touches HBM (ops/segment_pallas.py).
+            from .segment_pallas import finals_match
 
-            no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, a1 - a0))
+            sel = np.zeros((len(finals), n_finals_cols), dtype=np.float32)
+            for slot, key in enumerate(finals):
+                a0, a1 = final_alloc[key]
+                sel[slot, a0:a1] = 1.0
+            gj_stack = jnp.stack(gj_per_group, axis=-1).astype(jnp.bfloat16)
+            weights_f = kernel_p[:, :, :n_finals_cols].reshape(-1, n_finals_cols)
             cols.append(
-                jax.lax.cond(jnp.any(m), run_final, lambda _, z=no_match: z, None)
-            )
+                finals_match(embed, weights_f, gj_stack, sel, w=w, q=q)
+            )  # [T, F] in allocation order
+        else:
+            for gj, key in zip(gj_per_group, finals):
+                a0, a1 = final_alloc[key]
+                m = mslice(a0, a1)  # [T, Q, NB]
+
+                # Prefilter gate (as in the bucketed tier): if none of this
+                # group's first segments matched anywhere in the block, skip
+                # the AND-any reduction entirely — benign-heavy traffic pays
+                # only the cheap any() read.
+                def run_final(_, m=m, gj=gj):
+                    return jnp.any(m & gj[:, :, None], axis=1)  # [T, NB]
+
+                no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, a1 - a0))
+                cols.append(
+                    jax.lax.cond(jnp.any(m), run_final, lambda _, z=no_match: z, None)
+                )
+        for items in finals.values():
             col_groups.extend(spec.branches[bi][0] for bi, _ in items)
         bh_all = jnp.concatenate(cols, axis=1)
         b2g = np.zeros((len(col_groups), spec.n_groups), dtype=np.float32)

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel for the conv-segment FINALS tier.
+
+The XLA path computes the finals (suffix-deduped branches' first
+segments) as part of one big ``conv_general_dilated`` whose contraction
+dim is only C≈26 channels — ~20% of the MXU's 128 K-lanes — and then
+re-reads the [T, Q, N] match scores for the AND-any reduction (~1.3 GB
+at serving shapes). This tier instead:
+
+1. builds im2col patches ``[T·Q, W·C]`` once in XLA (bf16, ~1 GB at
+   serving shapes — cheap next to the reads it removes; an in-VMEM
+   concat was tried first but Mosaic rejects lane-unaligned concats of
+   C=26 slices);
+2. runs ONE fused Pallas kernel per (targets × columns) tile in which
+   EVERY step is a matmul — no in-kernel reshapes (merging the
+   sublane-unaligned (Tt, Q) dims forced a relayout that made a first
+   version 10x slower than XLA):
+   - patches @ weights (K = W·C ≈ 442 → near MXU peak) + threshold
+     (score == 2W ⇔ segment match at that window);
+   - reachability-AND via a tiny [Gf, Nt] one-hot matmul broadcasting
+     each branch group's suffix vector to its columns;
+   - the any-over-Q reduction as a static block-diagonal [Tt, Tt·Q]
+     0/1 matmul (exact in bf16: counts ≤ Q ≪ 256).
+   The [T, Q, N] match bitmap never exists in HBM and is never re-read.
+
+CPU tests run in interpreter mode on small shapes; eligibility and the
+XLA fallback live in ``ops/segment.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _finals_kernel(patches_ref, weights_ref, g2_ref, sel_ref, rowsel_ref, out_ref, *, w):
+    """One (i, j) tile: [Tt] targets x [Nt] finals columns, M = Tt*Q rows.
+
+    patches_ref: [M, Kp] bf16 im2col windows (K = W*C zero-padded);
+    weights_ref: [Kp, Nt] bf16 segment kernel columns;
+    g2_ref: [M, Gf] bf16 per-group reachability rows (window-start order);
+    sel_ref: [Gf, Nt] bf16 one-hot column -> group;
+    rowsel_ref: [Tt, M] bf16 block-diagonal row -> target map;
+    out_ref: [Tt, Nt] int32 (0/1 column verdicts).
+    """
+    scores = jnp.dot(
+        patches_ref[...], weights_ref[...], preferred_element_type=jnp.float32
+    )  # [M, Nt]
+    m = scores >= jnp.float32(2.0 * w)
+    g = (
+        jnp.dot(g2_ref[...], sel_ref[...], preferred_element_type=jnp.float32)
+        > 0
+    )  # [M, Nt]
+    mg = (m & g).astype(jnp.bfloat16)
+    counts = jnp.dot(
+        rowsel_ref[...], mg, preferred_element_type=jnp.float32
+    )  # [Tt, Nt]
+    out_ref[...] = (counts > 0).astype(jnp.int32)
+
+
+def finals_match(
+    embed: jnp.ndarray,  # [T, Lp, C] bf16 channel planes (Lp = 1 + L + W)
+    weights: jnp.ndarray,  # [W*C, Nf] bf16 (finals columns of the conv kernel)
+    gj: jnp.ndarray,  # [T, Q, Gf] bf16 per-group reachability
+    sel: np.ndarray,  # [Gf, Nf] one-hot column -> group (host constant)
+    *,
+    w: int,
+    q: int,
+    block_t: int = 32,
+    block_n: int = 256,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Fused finals evaluation. Returns [T, Nf] bool column verdicts."""
+    t, lp, c = embed.shape
+    nf = weights.shape[1]
+    gf = gj.shape[2]
+    kp = _round_up(w * c, _LANE)
+    np_cols = _round_up(max(nf, block_n), block_n)
+    m_rows = block_t * q
+
+    # im2col in XLA: W shifted channel-plane views, zero-padded to Kp,
+    # flattened to [T*Q, Kp] (row-major — contiguous, no relayout).
+    patches = jnp.concatenate(
+        [embed[:, wi : wi + q, :] for wi in range(w)], axis=-1
+    )  # [T, Q, W*C]
+    patches = jnp.pad(patches, ((0, 0), (0, 0), (0, kp - w * c))).reshape(
+        t * q, kp
+    )
+    g2 = gj.reshape(t * q, gf)
+
+    weights_p = jnp.pad(
+        weights.astype(jnp.bfloat16), ((0, kp - w * c), (0, np_cols - nf))
+    )
+    sel_p = jnp.asarray(
+        np.pad(sel, ((0, 0), (0, np_cols - nf))), dtype=jnp.bfloat16
+    )
+    rowsel = np.zeros((block_t, m_rows), dtype=np.float32)
+    for ti in range(block_t):
+        rowsel[ti, ti * q : (ti + 1) * q] = 1.0
+    rowsel_b = jnp.asarray(rowsel, dtype=jnp.bfloat16)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(_finals_kernel, w=w)
+    out = pl.pallas_call(
+        kernel,
+        grid=(t // block_t, np_cols // block_n),
+        in_specs=[
+            pl.BlockSpec((m_rows, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((kp, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((m_rows, gf), lambda i, j: (i, 0)),
+            pl.BlockSpec((gf, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((block_t, m_rows), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_n), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((t, np_cols), jnp.int32),
+        interpret=interpret,
+    )(patches, weights_p, g2, sel_p, rowsel_b)
+    return out[:, :nf] != 0

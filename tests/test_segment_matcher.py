@@ -139,3 +139,63 @@ def test_group_routing_in_model():
     model = build_model(compile_rules(rules))
     assert sum(s.n_groups for s in model.segs) >= 1
     assert sum(b.n_groups for b in model.banks) >= 1
+
+
+def test_pallas_finals_matches_xla_path(monkeypatch):
+    """The fused Pallas finals tier (interpret mode on CPU) must agree
+    with the XLA conv + AND-any path on the same block."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from coraza_kubernetes_operator_tpu.compiler.re_parser import parse_regex
+    from coraza_kubernetes_operator_tpu.compiler.segments import plan_segments
+    from coraza_kubernetes_operator_tpu.ops import segment as S
+
+    pats = [
+        r"\bunion\s+select\b",
+        r"attack\d+\s*=\s*\d+",
+        r"drop\s+table",
+        r"<script[^>]*>",
+        r"eval\s*\(",
+    ]
+    plans = [plan_segments(parse_regex(p)) for p in pats]
+    assert all(p is not None for p in plans)
+    blk = S.build_segment_block(plans)
+
+    texts = [
+        b"union  select a from b",
+        b"x attack123 = 99 y",
+        b"DROP TABLE users",  # case-sensitive pattern: no match
+        b"<script src=a>",
+        b"eval (payload)",
+        b"nothing to see",
+        b"union of selections",
+        b"attack7=3",
+    ]
+    T = 64  # pallas block size
+    L = 32
+    data = np.zeros((T, L), dtype=np.uint8)
+    lengths = np.zeros(T, dtype=np.int32)
+    for i, txt in enumerate(texts):
+        data[i, : len(txt)] = list(txt)
+        lengths[i] = len(txt)
+
+    ref = S.match_segment_block(blk.kernel, blk.spec, jnp.asarray(data), jnp.asarray(lengths))
+
+    monkeypatch.setattr(S, "_use_pallas_finals", lambda t, n: True)
+    jax.clear_caches()
+    try:
+        got = S.match_segment_block(
+            blk.kernel, blk.spec, jnp.asarray(data), jnp.asarray(lengths)
+        )
+    finally:
+        jax.clear_caches()
+    assert np.array_equal(np.asarray(got), np.asarray(ref))
+    # sanity: the reference itself matches python re on the real rows
+    import re
+
+    for i, txt in enumerate(texts):
+        for gi, p in enumerate(pats):
+            want = re.search(p.encode(), txt) is not None
+            assert bool(ref[i, gi]) == want, (p, txt)
