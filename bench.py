@@ -144,6 +144,19 @@ def _config_3(iters, n_chunks, n_rules):
     res["rules_compiled"] = eng.compiled.n_rules
     res["groups"] = eng.compiled.n_groups
     res["seg_groups"] = sum(s.n_groups for s in eng.model.segs)
+    # Latency mode: small 512-request steps against the p99 < 2ms budget
+    # (throughput mode above right-sizes batch for req/s instead). The
+    # percentile is over per-dispatch mean step times — the tunnel hides
+    # intra-dispatch tails — so take enough dispatch samples for the p99
+    # label to mean something.
+    lat_iters = max(8, iters)
+    lat = _serve_throughput(eng, 512, lat_iters, max(n_chunks, 128))
+    res["latency_512"] = {
+        "p50_step_ms": lat["p50_chunk_ms"],
+        "p99_step_ms": lat["p99_chunk_ms"],
+        "req_per_s": lat["req_per_s"],
+        "dispatch_samples": lat_iters,
+    }
     return res
 
 
@@ -175,14 +188,17 @@ def _config_5(iters, n_tenants=32):
     # 32 tenants sharing 4 distinct compiled rulesets (shape-realistic:
     # tenants fork few base policies; keeps bench compile time bounded).
     tenant_engine = {f"t{i}": engines[i % len(engines)] for i in range(n_tenants)}
-    requests = synthetic_requests(1024, attack_ratio=0.1, seed=2)
+    requests = synthetic_requests(2048, attack_ratio=0.1, seed=2)
 
-    # Warm every distinct executable.
-    per = {e: None for e in engines}
+    # Model-coalesced serving (the MicroBatcher's grouping: one device
+    # step per DISTINCT MODEL in a window, not per tenant — 32 tenants
+    # over 4 models = 4 steps per window). Warm every distinct
+    # executable with its window-share batch.
+    per = {}
     for e in engines:
         ex = [e.extractor.extract(r) for r in requests]
-        per[e] = jax.device_put(tuple(e._tensorize(ex)))
-        jax.block_until_ready(eval_waf(e.model, *per[e])["interrupted"])
+        per[id(e)] = jax.device_put(tuple(e._tensorize(ex)))
+        jax.block_until_ready(eval_waf(e.model, *per[id(e)])["interrupted"])
 
     tenants = list(tenant_engine)
     served = 0
@@ -192,23 +208,35 @@ def _config_5(iters, n_tenants=32):
     i = 0
     outs = []
     while time.perf_counter() < deadline:
-        tenant = tenants[i % len(tenants)]
-        eng = tenant_engine[tenant]
-        outs.append(eval_waf(eng.model, *per[eng])["interrupted"])
-        served += 1024
+        # One coalesced window = 2048 requests PER distinct model (the
+        # MicroBatcher groups a window's tenants by model, so a window
+        # of ~8k requests over 32 tenants lands as ~4 model-sized device
+        # steps of ~2k rows each — which is exactly what is dispatched
+        # and counted here).
+        models = {id(tenant_engine[t]): tenant_engine[t] for t in tenants}
+        for key, eng in models.items():
+            outs.append(eval_waf(eng.model, *per[key])["interrupted"])
+            served += 2048
         i += 1
-        if i % 64 == 0:
+        if i % 16 == 0:
             # Hot reload: swap one tenant to a different resident model —
             # the sidecar's UUID-change path (recompile happens off-path).
-            tenant_engine[tenants[i % len(tenants)]] = engines[(i // 64) % len(engines)]
+            tenant_engine[tenants[i % len(tenants)]] = engines[(i // 16) % len(engines)]
             reloads += 1
         if len(outs) >= 8:
             jax.block_until_ready(outs)
             outs = []
     jax.block_until_ready(outs)
     wall = time.perf_counter() - t0
+    device_rps = served / wall
+    # (A MicroBatcher-driven e2e variant was measured and removed: each
+    # window's fresh shape bucket retraces through the axon tunnel's
+    # ~100ms remote dispatch/compile, so the number reflected the tunnel,
+    # not the batcher — the batcher's window/grouping logic is covered by
+    # tests/test_sidecar.py and test_multitenant.py instead.)
+
     return {
-        "req_per_s": round(served / wall, 1),
+        "req_per_s": round(device_rps, 1),
         "tenants": n_tenants,
         "distinct_models": len(engines),
         "hot_reloads": reloads,
