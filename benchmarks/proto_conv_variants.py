@@ -1,0 +1,91 @@
+"""Conv micro-variants at serving shapes: dtype and layout experiments.
+
+Variants:
+  A. bf16 conv (current production formulation)
+  B. int8 conv, int32 accumulation (v5e MXU runs int8 at 2x bf16)
+  C. f32 conv (sanity: is bf16 even helping?)
+  D. bf16 conv with C padded 26 -> 32
+  E. bf16 conv, batch*4 rows / quarter chunks (occupancy probe)
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_CHUNK = 32
+
+
+def bench_mapped(fn, embed, iters=5):
+    @jax.jit
+    def run(embed):
+        def chunk(i):
+            e = embed.at[0, 0, 0].set(i.astype(embed.dtype))
+            return fn(e).sum()
+
+        return jax.lax.map(chunk, jnp.arange(N_CHUNK, dtype=jnp.int32))
+
+    out = run(embed)
+    jax.block_until_ready(out)
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = run(embed)
+        jax.block_until_ready(out)
+        walls.append(time.perf_counter() - t0)
+    return min(walls) / N_CHUNK
+
+
+def conv(e, k, acc):
+    return jax.lax.conv_general_dilated(
+        e, k, window_strides=(1,), padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=acc,
+    )
+
+
+def main():
+    W, N = 17, 783
+    rng = np.random.default_rng(0)
+    for label, T, L in (("short", 2745, 32), ("long", 1351, 128)):
+        C = 26
+        q = L + 2
+        e_np = rng.integers(0, 2, (T, 1 + L + W, C)).astype(np.float32)
+        k_np = rng.integers(0, 3, (W, C, N)).astype(np.float32)
+        thr = 2.0 * W
+
+        e_bf = jnp.asarray(e_np, dtype=jnp.bfloat16)
+        k_bf = jnp.asarray(k_np, dtype=jnp.bfloat16)
+        tA = bench_mapped(lambda e: conv(e, k_bf, jnp.bfloat16) >= jnp.bfloat16(thr), e_bf)
+
+        e_i8 = jnp.asarray(e_np, dtype=jnp.int8)
+        k_i8 = jnp.asarray(k_np, dtype=jnp.int8)
+        try:
+            tB = bench_mapped(
+                lambda e: conv(e, k_i8, jnp.int32) >= jnp.int32(thr), e_i8
+            )
+        except Exception as err:
+            tB = float("nan")
+            print("int8 failed:", type(err).__name__, str(err)[:120])
+
+        e_f32 = jnp.asarray(e_np)
+        k_f32 = jnp.asarray(k_np)
+        tC = bench_mapped(lambda e: conv(e, k_f32, jnp.float32) >= thr, e_f32)
+
+        e_p = jnp.asarray(np.pad(e_np, ((0, 0), (0, 0), (0, 6))), dtype=jnp.bfloat16)
+        k_p = jnp.asarray(np.pad(k_np, ((0, 0), (0, 6), (0, 0))), dtype=jnp.bfloat16)
+        tD = bench_mapped(lambda e: conv(e, k_p, jnp.bfloat16) >= jnp.bfloat16(thr), e_p)
+
+        print(
+            f"{label}: bf16 {tA*1e3:7.3f}  int8 {tB*1e3:7.3f}  "
+            f"f32 {tC*1e3:7.3f}  bf16-C32 {tD*1e3:7.3f} ms"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Conv vs explicit im2col+matmul at serving shapes.
+
+F. stack W shifted slices -> [T, Q, W*C] -> one [W*C, N] matmul
+G. same but reshape to 2D [T*Q, W*C] first
+H. conv_general_dilated_patches + matmul
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_CHUNK = 32
+
+
+def bench_mapped(fn, embed, iters=5):
+    @jax.jit
+    def run(embed):
+        def chunk(i):
+            e = embed.at[0, 0, 0].set(i.astype(embed.dtype))
+            return fn(e).sum()
+
+        return jax.lax.map(chunk, jnp.arange(N_CHUNK, dtype=jnp.int32))
+
+    out = run(embed)
+    jax.block_until_ready(out)
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = run(embed)
+        jax.block_until_ready(out)
+        walls.append(time.perf_counter() - t0)
+    return min(walls) / N_CHUNK
+
+
+def main():
+    W, N = 17, 783
+    rng = np.random.default_rng(0)
+    for label, T, L in (("short", 2745, 32), ("long", 1351, 128)):
+        C = 26
+        q = L + 2
+        e_np = rng.integers(0, 2, (T, 1 + L + W, C)).astype(np.float32)
+        k_np = rng.integers(0, 3, (W, C, N)).astype(np.float32)
+        thr = jnp.bfloat16(2.0 * W)
+
+        e_bf = jnp.asarray(e_np, dtype=jnp.bfloat16)
+        k_bf = jnp.asarray(k_np, dtype=jnp.bfloat16)
+        k_flat = k_bf.reshape(W * C, N)
+
+        def conv_a(e):
+            out = jax.lax.conv_general_dilated(
+                e, k_bf, window_strides=(1,), padding="VALID",
+                dimension_numbers=("NWC", "WIO", "NWC"),
+                preferred_element_type=jnp.bfloat16,
+            )
+            return out >= thr
+
+        def im2col_f(e):
+            t = e.shape[0]
+            qq = e.shape[1] - W + 1
+            pats = jnp.stack([e[:, w : w + qq, :] for w in range(W)], axis=2)
+            pats = pats.reshape(t, qq, W * C)
+            out = jnp.einsum(
+                "tqk,kn->tqn", pats, k_flat, preferred_element_type=jnp.bfloat16
+            )
+            return out >= thr
+
+        def im2col_g(e):
+            t = e.shape[0]
+            qq = e.shape[1] - W + 1
+            pats = jnp.stack([e[:, w : w + qq, :] for w in range(W)], axis=2)
+            pats = pats.reshape(t * qq, W * C)
+            out = jnp.dot(pats, k_flat, preferred_element_type=jnp.bfloat16)
+            return (out >= thr).reshape(t, qq, N)
+
+        def patches_h(e):
+            t = e.shape[0]
+            qq = e.shape[1] - W + 1
+            pats = jax.lax.conv_general_dilated_patches(
+                e, (W,), (1,), "VALID", dimension_numbers=("NWC", "WIO", "NWC")
+            )  # [T, qq, C*W] (feature-major order: C outer? check via equality)
+            out = jnp.einsum(
+                "tqk,kn->tqn",
+                pats,
+                k_bf.transpose(1, 0, 2).reshape(C * W, N),
+                preferred_element_type=jnp.bfloat16,
+            )
+            return out >= thr
+
+        ra = jax.jit(conv_a)(e_bf)
+        for nm, fn in (("F", im2col_f), ("G", im2col_g), ("H", patches_h)):
+            try:
+                rr = jax.jit(fn)(e_bf)
+                ok = bool(jnp.all(ra == rr))
+            except Exception as err:
+                print(nm, "failed:", type(err).__name__, str(err)[:100])
+                continue
+            tt = bench_mapped(fn, e_bf)
+            print(f"{label} {nm}: {tt*1e3:7.3f} ms  match={ok}")
+        ta = bench_mapped(conv_a, e_bf)
+        print(f"{label} A(conv): {ta*1e3:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

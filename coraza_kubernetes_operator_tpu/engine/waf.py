@@ -90,6 +90,7 @@ class WafEngine:
         self.compiled = rules if isinstance(rules, CompiledRuleSet) else compile_rules(rules)
         self.model: WafModel = build_model(self.compiled)
         self.extractor = TargetExtractor(self.compiled)
+        self._targets_used = {coll for coll, _ in self.compiled.vocab.kinds}
         self._n_real_rules = len(self.compiled.rules)  # model pads to ≥1 row
         self._rule_ids = np.asarray(
             [r.rule_id for r in self.compiled.rules] or [0], dtype=np.int64
@@ -248,18 +249,37 @@ class WafEngine:
                 verdicts[i] = verdict
         return verdicts  # type: ignore[return-value]
 
-    @staticmethod
-    def _split_requests(requests: list[HttpRequest]) -> tuple[list[int], list[int]]:
+    def _split_requests(self, requests: list[HttpRequest]) -> tuple[list[int], list[int]]:
         """Length-class split on raw requests (native path: extraction
-        happens in C++). Conservative — any long field forces the long
-        class; a miss only affects the sub-batch's bucket, not verdicts."""
+        happens in C++). Bounds the synthesized targets too —
+        REQUEST_LINE and FULL_REQUEST are the only extracted targets
+        that can exceed every raw field (engine/request.py:200-206);
+        all others are substrings or decodings of raw fields. The bound
+        is conservative (FULL_REQUEST counted only if a rule targets
+        it), so membership can still differ from the Python path's
+        extracted-length split when an unused synthesized target is the
+        longest field; that only widens a sub-batch's length bucket,
+        never changes a verdict."""
         thr = SHORT_REQUEST_LEN
+        count_full = "FULL_REQUEST" in self._targets_used
         short: list[int] = []
         long_: list[int] = []
         for i, r in enumerate(requests):
+            body_len = len(r.body or b"")
+            line_len = len(r.method) + len(r.uri) + len(r.version) + 2
+            full_ok = True
+            if count_full:
+                full_len = (
+                    line_len
+                    + 4
+                    + sum(len(k) + len(v) + 4 for k, v in r.headers)
+                    + body_len
+                )
+                full_ok = full_len <= thr
             if (
-                len(r.uri) <= thr
-                and len(r.body or b"") <= thr
+                line_len <= thr
+                and body_len <= thr
+                and full_ok
                 and all(len(k) <= thr and len(v) <= thr for k, v in r.headers)
             ):
                 short.append(i)

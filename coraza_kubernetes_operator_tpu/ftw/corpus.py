@@ -26,8 +26,11 @@ def load_ruleset_text(root: str | Path = CRS_LITE_DIR) -> str:
         return p.name.startswith(("REQUEST-", "RESPONSE-"))
 
     setup = sorted(p for p in root.glob("*.conf") if not is_rule_file(p))
+    # (family, name) keeps a deterministic total order even when one
+    # family spans multiple .conf files — rule order matters for
+    # setvar/anomaly accumulation.
     rules = sorted((p for p in root.glob("*.conf") if is_rule_file(p)),
-                   key=lambda p: p.name.split("-", 2)[1])
+                   key=lambda p: (p.name.split("-", 2)[1], p.name))
     parts = [f"SecDataDir {root / 'data'}"]
     for path in setup + rules:
         parts.append(path.read_text())

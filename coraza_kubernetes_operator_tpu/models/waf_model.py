@@ -367,9 +367,13 @@ def segment_tier_hits(
     order after ``seg_perm``). Shared by the single-chip ``eval_waf``
     and the rule-sharded path (``parallel/mesh.py``)."""
     from ..ops.dfa import scan_dfa_bank
-    from ..ops.segment import match_segment_block
+    from ..ops.segment import conv_n2_cols, match_segment_block
 
-    n_seg_cols = sum(int(s.kernel.shape[2]) for s in segs)
+    # Budget on the DUPLICATED column count (conv_n2_cols — what the
+    # [T, Q, N2] conv output actually allocates), not the deduped
+    # kernel.shape[2]; the gapcls NCE tables are O(T·Q) since the
+    # cumsum fallback (ops/segment.py) and need no budget term.
+    n_seg_cols = sum(conv_n2_cols(s.spec) for s in segs)
     bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
     use_long = bool(long_banks) and (
         _SEG_BITMAP_ELEMS > 0 and bitmap_elems > _SEG_BITMAP_ELEMS

@@ -66,6 +66,12 @@ import os as _os
 _PALLAS_FINALS = _os.environ.get("CKO_PALLAS_FINALS", "0") == "1"
 _FINALS_BLOCK_T = 32
 
+# Above this Q the NCE prefix sum uses jnp.cumsum instead of a [Q, Q]
+# triangular matmul — the table is O(Q²) HBM and on long-body buckets
+# (up to SecRequestBodyLimit) would be a request-triggerable multi-GB
+# allocation.
+_NCE_MATMUL_MAX_Q = 512
+
 
 def _use_pallas_finals(t: int, n_cols: int) -> bool:
     return (
@@ -357,6 +363,29 @@ def _branch_signature(spec: SegmentSpec, prog: tuple, a_start: bool, a_end: bool
     return (tuple(sig), a_start, a_end)
 
 
+def conv_n2_cols(spec: SegmentSpec) -> int:
+    """Duplicated/permuted conv output column count — ``len(col_order)``
+    as ``match_segment_block`` will build it. The long-body budget in
+    ``segment_tier_hits`` must use this, not ``kernel.shape[2]``: shared
+    segments are duplicated per consumer slice, so N2 ≥ N and the conv
+    output is ``[T, Q, N2]``, which is what actually occupies HBM."""
+    n2 = 0
+    suffixes: set[tuple] = set()
+    for _, prog, _, a_end in spec.branches:
+        if len(prog) >= 2 and prog[0][0] == "seg":
+            n2 += 1  # finals tier: one column for the first segment
+            suffixes.add((prog[1:], a_end))
+        else:
+            # signature-bucketed tier: one column per seg element.
+            n2 += sum(1 for el in prog if el[0] == "seg")
+    # suffix-deduped chains: one column per seg element per DISTINCT
+    # suffix (grouping by structural signature only changes slicing,
+    # not the total).
+    for ops, _ in suffixes:
+        n2 += sum(1 for el in ops if el[0] == "seg")
+    return max(1, n2)
+
+
 @partial(jax.jit, static_argnames=("spec",))
 def match_segment_block(
     kernel: jnp.ndarray,  # [W, C, N] bf16
@@ -509,27 +538,41 @@ def match_segment_block(
     # tracers created inside one cond branch must not be cached and reused
     # inside another trace.
     #
-    # NCE (count of non-class bytes before p) is itself a prefix sum —
-    # computed as one [Q, Q] triangular matmul, NOT jnp.cumsum: cumulative
-    # ops along a 66-long axis lower to reduce-window on TPU, which
-    # profiled at ~1/4 of this whole block's runtime. Q is tiny, so the
-    # O(Q²) matmul is ~free on the MXU (and exact in bf16: sums ≤ Q ≪
-    # 256). M_cls[t, p', p] = (p' ≥ p ∧ NCE[p'] == NCE[p]) is the
-    # "suffix of p is class-clean through p'" reachability operand used
-    # by unbounded class gaps.
-    tri_excl = jnp.asarray(
-        np.triu(np.ones((q, q), dtype=np.float32), 1), dtype=jnp.bfloat16
-    )  # [p', p]: p' < p
+    # NCE (count of non-class bytes before p) is itself a prefix sum.
+    # For small Q it is one [Q, Q] triangular matmul, NOT jnp.cumsum:
+    # cumulative ops along a 66-long axis lower to reduce-window on TPU,
+    # which profiled at ~1/4 of this whole block's runtime, and Q is tiny
+    # so the O(Q²) matmul is ~free on the MXU (exact in bf16: sums ≤ Q ≪
+    # 256). Above _NCE_MATMUL_MAX_Q the [Q, Q] table would dominate HBM
+    # (and on large length buckets — up to SecRequestBodyLimit — attempt
+    # a multi-GB allocation), so the exclusive prefix sum falls back to
+    # jnp.cumsum: O(Q) memory, and at that Q the reduce-window cost is
+    # amortized over a proportionally larger block anyway. The table is
+    # built lazily — rulesets with no gapcls op never materialize it.
+    # M_cls[t, p', p] = (p' ≥ p ∧ NCE[p'] == NCE[p]) is the "suffix of p
+    # is class-clean through p'" reachability operand used by unbounded
+    # class gaps.
+    tri_excl = None
     _tabs_cache: dict[tuple, tuple] = {}
     for _, prog, _, _ in spec.branches:
         for el in prog:
             if el[0] == "gapcls" and el[1] not in _tabs_cache:
                 in_c = _in_class(el[1], dpad)[:, :q]  # byte at p ∈ class
-                non_c = (~in_c).astype(jnp.bfloat16)
-                # non-C bytes in [0, p): exclusive prefix sum via matmul.
-                nce = jnp.dot(
-                    non_c, tri_excl, preferred_element_type=jnp.float32
-                ).astype(jnp.int32)
+                if q > _NCE_MATMUL_MAX_Q:
+                    non_i = (~in_c).astype(jnp.int32)
+                    # exclusive prefix sum: inclusive cumsum minus self.
+                    nce = jnp.cumsum(non_i, axis=1) - non_i
+                else:
+                    non_c = (~in_c).astype(jnp.bfloat16)
+                    if tri_excl is None:
+                        tri_excl = jnp.asarray(
+                            np.triu(np.ones((q, q), dtype=np.float32), 1),
+                            dtype=jnp.bfloat16,
+                        )  # [p', p]: p' < p
+                    # non-C bytes in [0, p): exclusive prefix sum via matmul.
+                    nce = jnp.dot(
+                        non_c, tri_excl, preferred_element_type=jnp.float32
+                    ).astype(jnp.int32)
                 _tabs_cache[el[1]] = (in_c, nce)
 
     def gap_cls_tabs(ivs: tuple):

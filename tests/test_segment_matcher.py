@@ -199,3 +199,68 @@ def test_pallas_finals_matches_xla_path(monkeypatch):
         for gi, p in enumerate(pats):
             want = re.search(p.encode(), txt) is not None
             assert bool(ref[i, gi]) == want, (p, txt)
+
+
+def test_gapcls_cumsum_path_at_large_q():
+    """Above _NCE_MATMUL_MAX_Q the NCE prefix sum must switch to the
+    O(Q) cumsum (no [Q, Q] table — a request-triggerable multi-GB
+    allocation on long-body buckets) and stay byte-exact vs Python re."""
+    pats = [(r"<script[^>]*>", True), (r"select\b.+\bfrom", True)]
+    plans = [plan_segments(parse_regex(p, case_insensitive=ci)) for p, ci in pats]
+    block = build_segment_block(plans)
+
+    from coraza_kubernetes_operator_tpu.ops import segment as seg_mod
+
+    max_len = seg_mod._NCE_MATMUL_MAX_Q + 70  # q = max_len + 2 > threshold
+    rng = random.Random(7)
+    rows = [
+        b"x" * max_len,
+        # positives with the match DEEP in the buffer (past the 512
+        # matmul/cumsum threshold) — must fit inside max_len
+        (b"z" * 540) + b"<script src=a>" + b"y" * 20,
+        b"select " + b"a" * 530 + b" from t",
+        b"<script" + b">" * 1,  # short content, long bucket
+        bytes(rng.randrange(32, 127) for _ in range(max_len)),
+    ]
+    assert all(len(c) <= max_len for c in rows[1:3])
+    data = np.zeros((len(rows), max_len), dtype=np.uint8)
+    lengths = np.zeros(len(rows), dtype=np.int32)
+    for i, c in enumerate(rows):
+        data[i, : len(c)] = np.frombuffer(c[:max_len], dtype=np.uint8)
+        lengths[i] = min(len(c), max_len)
+
+    hits = np.asarray(match_segment_block(block.kernel, block.spec, data, lengths))
+    for gi, (pat, ci) in enumerate(pats):
+        oracle = re.compile(pat.encode(), re.IGNORECASE if ci else 0)
+        for i, c in enumerate(rows):
+            want = oracle.search(c[:max_len]) is not None
+            assert bool(hits[i, gi]) == want, (pat, i)
+
+
+def test_conv_n2_cols_matches_trace_allocation():
+    """conv_n2_cols must equal len(col_order) as match_segment_block
+    builds it — the HBM budget in segment_tier_hits depends on it."""
+    from coraza_kubernetes_operator_tpu.ops.segment import conv_n2_cols
+
+    plans = []
+    for pat, ci in PATTERNS:
+        plans.append(plan_segments(parse_regex(pat, case_insensitive=ci)))
+    block = build_segment_block(plans)
+    spec = block.spec
+
+    # Reproduce the trace-time classification/allocation column count.
+    n_cols = 0
+    suffixes = set()
+    for _, prog, _, a_end in spec.branches:
+        if len(prog) >= 2 and prog[0][0] == "seg":
+            n_cols += 1
+            suffixes.add((prog[1:], a_end))
+        else:
+            n_cols += sum(1 for el in prog if el[0] == "seg")
+    for ops, _ in suffixes:
+        n_cols += sum(1 for el in ops if el[0] == "seg")
+    assert conv_n2_cols(spec) == max(1, n_cols)
+    # Duplication means N2 >= the deduped kernel column count is NOT
+    # guaranteed per-spec, but for this corpus (shared segments across
+    # branches) the duplicated count must be >= distinct segments used.
+    assert conv_n2_cols(spec) >= 1
