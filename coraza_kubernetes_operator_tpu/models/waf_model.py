@@ -91,6 +91,8 @@ class WafModel:
     e_lg: jnp.ndarray  # [G, Rl] int8 one-hot of lgroup
     m_count: jnp.ndarray  # [Rl, Rr] int8: multiplicity of link l in rule r
     link_count: jnp.ndarray  # [Rr] int32: number of links per rule
+    e_numvar: jnp.ndarray  # [NV, Rl] f32 one-hot of lnumvar
+    e_counter: jnp.ndarray  # [C, Rl] f32 one-hot of lcounter
     # rule arrays [Rr]
     link_matrix: jnp.ndarray  # [Rr, MX]
     link_mask: jnp.ndarray  # [Rr, MX]
@@ -134,6 +136,8 @@ class WafModel:
             self.e_lg,
             self.m_count,
             self.link_count,
+            self.e_numvar,
+            self.e_counter,
             self.link_matrix,
             self.link_mask,
             self.decision,
@@ -311,6 +315,19 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         link_count[i] = len(rule.link_ids)
         for lid in rule.link_ids:
             m_count[lid, i] += 1
+    # numvar/counter selection as one-hot matmul operands: the gather
+    # forms numvals[:, lnumvar] / counters[:, lcounter] produce [B, Rl]
+    # outputs through XLA's serializing TPU gather (profiled at ~40% of
+    # post_match); the contraction rides the MXU instead, split into
+    # 12-bit halves at eval time so it is exact for the FULL int32 range
+    # (body-length scalars are attacker-controlled and exceed 2^24).
+    nv = max(1, crs.numvars.n_vars if hasattr(crs, "numvars") else 1)
+    n_counters = weights.shape[1]
+    e_numvar = np.zeros((nv, rl), dtype=np.float32)
+    e_counter = np.zeros((n_counters, rl), dtype=np.float32)
+    for i in range(rl):
+        e_numvar[min(lnumvar[i], nv - 1), i] = 1.0
+        e_counter[min(lcounter[i], n_counters - 1), i] = 1.0
 
     return WafModel(
         banks=banks,
@@ -327,6 +344,8 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         e_lg=jnp.asarray(e_lg),
         m_count=jnp.asarray(m_count),
         link_count=jnp.asarray(link_count),
+        e_numvar=jnp.asarray(e_numvar),
+        e_counter=jnp.asarray(e_counter),
         link_matrix=jnp.asarray(link_matrix),
         link_mask=jnp.asarray(link_mask),
         decision=jnp.asarray(decision),
@@ -422,11 +441,24 @@ def eval_waf(
     max_phase: int = 2,
 ):
     """Evaluate one batch. Returns a dict of per-request verdict arrays."""
-    b = numvals.shape[0]
+    group_hits = match_tier(model, data, lengths, variant_data, variant_lengths)
+    return post_match(
+        model, group_hits, kind1, kind2, kind3, req_id, numvals, max_phase
+    )
 
-    # 1+2: transforms + matchers → per-target group hits. Segment blocks
-    # first, DFA banks after — the same global order build_model's remap
-    # assigned.
+
+def match_tier(
+    model: WafModel,
+    data: jnp.ndarray,  # [T, L] uint8
+    lengths: jnp.ndarray,  # [T]
+    variant_data: jnp.ndarray,  # [H, T, L]
+    variant_lengths: jnp.ndarray,  # [H, T]
+) -> jnp.ndarray:
+    """Stages 1+2 for ONE length tier: transforms + matchers → per-target
+    group hits [T, G]. Segment blocks first, DFA banks after — the same
+    global order build_model's remap assigned. Tiers are independent
+    until post_match (rows only meet at the req_id reduction), which is
+    what makes row-level length tiering (``eval_waf_tiered``) sound."""
     per_block: list[jnp.ndarray] = []
     transformed: dict[int, tuple[jnp.ndarray, jnp.ndarray]] = {}
     from ..ops.dfa import scan_dfa_bank
@@ -457,12 +489,41 @@ def eval_waf(
         tdata, tlen = transformed_for(pid)
         per_block.append(scan_dfa_bank(bank, tdata, tlen))
     if per_block:
-        group_hits = jnp.concatenate(per_block, axis=1)  # [T, G]
-    else:
-        group_hits = jnp.zeros((data.shape[0], 1), dtype=bool)
+        return jnp.concatenate(per_block, axis=1)  # [T, G]
+    return jnp.zeros((data.shape[0], 1), dtype=bool)
 
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2):
+    """Row-level length-tiered, value-deduped evaluation. ``tiers`` is a
+    tuple of ``(data, lengths, kind1, kind2, kind3, req_id, vdata,
+    vlengths, uid)`` per length class (``engine.waf.tier_tensors``):
+    the matcher arrays hold UNIQUE target values only (real traffic
+    repeats header values/names and hot paths constantly — a serving
+    batch collapses ~5-15x), each tier's matcher runs at its own buffer
+    width (conv work is linear in Q = L + 2, so a long request's short
+    rows never pay the body's width), the unique group-hit rows expand
+    back to per-(target, kinds) pair rows by index, and one global
+    post_match reduces all pair rows by req_id. Request atomicity holds
+    because req_id is global across tiers and post_match is the only
+    cross-row stage."""
+    hits, k1s, k2s, k3s, rids = [], [], [], [], []
+    for (data, lengths, k1, k2, k3, rid, vd, vl, uid) in tiers:
+        hits_u = match_tier(model, data, lengths, vd, vl)
+        hits.append(jnp.take(hits_u, uid, axis=0))  # [P, G] pair rows
+        k1s.append(k1)
+        k2s.append(k2)
+        k3s.append(k3)
+        rids.append(rid)
     return post_match(
-        model, group_hits, kind1, kind2, kind3, req_id, numvals, max_phase
+        model,
+        jnp.concatenate(hits, axis=0),
+        jnp.concatenate(k1s),
+        jnp.concatenate(k2s),
+        jnp.concatenate(k3s),
+        jnp.concatenate(rids),
+        numvals,
+        max_phase,
     )
 
 
@@ -537,8 +598,29 @@ def post_match(
         > 0
     )  # [B, Rl]
 
-    # 4b: numeric links.
-    vals = numvals[:, model.lnumvar]  # [B, Rl]
+    # 4b: numeric links. One-hot f32 matmul, not numvals[:, lnumvar]: the
+    # [B, Rl] dynamic gather serializes on TPU (profiled at a large share
+    # of post_match). A single f32 contraction would round values >= 2^24
+    # (REQUEST_BODY_LENGTH / FULL_REQUEST_LENGTH are attacker-controlled
+    # and can exceed 16 MB, flipping size-limit rules), so the int32 is
+    # split into 12-bit-shifted halves — each exact in f32 — and
+    # recombined after the selection.
+    def _sel_exact(values_i32: jnp.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
+        hi = jnp.dot(
+            (values_i32 >> 12).astype(jnp.float32),
+            onehot,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        lo = jnp.dot(
+            (values_i32 & 0xFFF).astype(jnp.float32),
+            onehot,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        return (hi << 12) | lo
+
+    vals = _sel_exact(numvals, model.e_numvar)  # [B, Rl]
     m_num = _compare(model.lcmp[None, :], vals, model.lcmparg[None, :]) ^ model.lneg[None, :]
 
     m_always = jnp.broadcast_to(~model.lneg[None, :], m_str.shape)
@@ -576,7 +658,8 @@ def post_match(
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     ).astype(jnp.int32)
-    cvals = counters[:, model.lcounter]
+    # counters[:, lcounter] as the same exact split contraction (see 4b).
+    cvals = _sel_exact(counters, model.e_counter)  # [B, Rl]
     m_counter = _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :]) ^ model.lneg[None, :]
     link_m = jnp.where(lt == LINK_COUNTER, m_counter, link_m)
     matched = rules_from_links(link_m)
@@ -603,16 +686,13 @@ def post_match(
     }
 
 
-@partial(jax.jit, static_argnames=("max_phase",))
-def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
-    """eval_waf with every verdict tensor packed into ONE int32 array
-    [B, 3 + ceil(Rr/8)/4 + C]: columns 0-2 are (interrupted, status,
-    rule_index), then bit-packed matched words, then the counters.
-    Serving reads ~25x fewer bytes in ONE transfer — device->host
-    readback (per-transfer round trips + bandwidth) is the serving
-    bottleneck once the host path is native. Unpack with
-    ``unpack_compact``."""
-    out = eval_waf.__wrapped__(model, *tensors, max_phase=max_phase)
+def _pack_verdicts(out) -> jnp.ndarray:
+    """Pack eval's verdict dict into ONE int32 array [B, 3 + nw + C]:
+    columns 0-2 are (interrupted, status, rule_index), then bit-packed
+    matched words, then the counters. Serving reads ~25x fewer bytes in
+    ONE transfer — device->host readback (per-transfer round trips +
+    bandwidth) is the serving bottleneck once the host path is native.
+    Unpack with ``unpack_compact``."""
     b = out["status"].shape[0]
     head = jnp.stack(
         [
@@ -630,6 +710,20 @@ def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
         bits.reshape(b, (nb + pad) // 4, 4), jnp.int32
     )  # [B, nw]
     return jnp.concatenate([head, words, out["scores"]], axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
+    """eval_waf + ``_pack_verdicts`` in one dispatch."""
+    return _pack_verdicts(eval_waf.__wrapped__(model, *tensors, max_phase=max_phase))
+
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_waf_compact_tiered(model: WafModel, tiers, numvals, max_phase: int = 2):
+    """eval_waf_tiered + ``_pack_verdicts`` in one dispatch."""
+    return _pack_verdicts(
+        eval_waf_tiered.__wrapped__(model, tiers, numvals, max_phase=max_phase)
+    )
 
 
 def unpack_compact(packed: np.ndarray, n_rules: int, n_counters: int):

@@ -101,6 +101,8 @@ def load_library():
         ctypes.c_int
     ] * 6
     lib.cko_result_free.argtypes = [ctypes.c_void_p]
+    lib.cko_sqli.restype = ctypes.c_int
+    lib.cko_sqli.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
     _lib = lib
     return _lib
 
@@ -172,11 +174,31 @@ def serialize_config(crs) -> bytes | None:
 
     nv_specs = sorted(crs.numvars.vars.items(), key=lambda kv: kv[1])
     nv_blobs = []
+    has_hostops = False
     for key, _slot in nv_specs:
         if key[0] == "hostop":
-            # Host-evaluated operator bits (libinjection-architecture
-            # @detectSQLi) have no native evaluator yet → python fallback.
-            return None
+            # Host-evaluated operator bits: the native tier ships the
+            # libinjection-architecture SQLi machine (tokenize → fold →
+            # fingerprint, cko_native.cpp:sq_is_sqli) with the tables
+            # generated by compiler/sqli.py (below) so they cannot skew.
+            _, opname, pipeline, include, exclude = key
+            if opname != "sqli":
+                return None  # unknown host op → python fallback
+            ops = []
+            for n in pipeline:
+                op = _OPCODES.get(n)
+                if op is None:
+                    return None
+                ops.append(op)
+            blob = struct.pack("<BB", 2, 0)  # type 2, op_id 0 = sqli
+            blob += struct.pack("<I", len(ops)) + bytes(ops)
+            blob += struct.pack("<I", len(include))
+            blob += b"".join(struct.pack("<I", k) for k in include)
+            blob += struct.pack("<I", len(exclude))
+            blob += b"".join(struct.pack("<I", k) for k in exclude)
+            nv_blobs.append(blob)
+            has_hostops = True
+            continue
         if key[0] == "scalar":
             try:
                 sid = _NUMERIC_ORDER.index(key[1])
@@ -195,6 +217,29 @@ def serialize_config(crs) -> bytes | None:
             )
     out.append(struct.pack("<I", len(nv_blobs)))
     out.extend(nv_blobs)
+
+    if has_hostops:
+        # SQLi tables, generated by compiler/sqli.py at import time.
+        from ..compiler import sqli as _sqli
+
+        word_classes: dict[bytes, bytes] = {}
+        for words, cls in (
+            (_sqli._KEYWORDS_U, b"U"), (_sqli._KEYWORDS_E, b"E"),
+            (_sqli._KEYWORDS_B, b"B"), (_sqli._KEYWORDS_K, b"k"),
+            (_sqli._LOGIC, b"&"), (_sqli._SQLTYPES, b"t"),
+            (_sqli._FUNCTIONS, b"f"), (_sqli._EVASION_WORDS, b"x"),
+        ):
+            for w in words:
+                wb = w.encode("latin-1")
+                word_classes.setdefault(wb, cls)
+        out.append(struct.pack("<I", len(word_classes)))
+        for wb, cls in sorted(word_classes.items()):
+            out.append(struct.pack("<H", len(wb)) + wb + cls)
+        fps = sorted(_sqli._FINGERPRINTS)
+        out.append(struct.pack("<I", len(fps)))
+        for fp in fps:
+            fb = fp.encode("latin-1")
+            out.append(struct.pack("<B", len(fb)) + fb)
     return b"".join(out)
 
 

@@ -54,17 +54,19 @@ from ..compiler.segments import Branch, Gap, Seg, SegmentPlan
 
 import os as _os
 
-# Experimental fused Pallas finals tier (ops/segment_pallas.py),
-# DISABLED by default: the kernel itself beats the XLA conv + AND-any
-# read (6.4 ms vs ~7.8 ms at serving shapes), but the im2col patches it
-# needs cost ~27 ms to build in XLA — lane-unaligned C=26 channel
-# concats relayout catastrophically, and Mosaic rejects the same concat
-# in VMEM. Net: the XLA conv path wins at these channel counts. The
-# kernel stays correct (interpret-mode differential test) and can be
-# enabled with CKO_PALLAS_FINALS=1 for rulesets with lane-aligned
-# channel counts where the economics flip.
+# Fused Pallas finals tier (ops/segment_pallas.py v2), measured and
+# DISABLED by default. v2 fixed v1's blocker (no XLA-side im2col — the
+# residue-block decomposition turns window extraction into 128-aligned
+# block indexing) and is exact (interpret-mode differential test), but
+# on v5e it still loses to the XLA conv at serving shapes: the
+# per-position [Tt, 128] x [128, Nt] dot form ran 11.6 ms/step and the
+# batched M = Tt*lr8 form 11.1 ms/step vs 6.9 ms/step for the XLA conv
+# path (batch 4096, 800 rules) — Mosaic's scheduling of many small
+# dependent dots plus the f32 [Tt, qr8, Nt] temporaries outweigh the
+# saved [T, Q, N] bitmap traffic. Kept for rulesets/hardware where the
+# economics flip; CKO_PALLAS_FINALS=1 opts in.
 _PALLAS_FINALS = _os.environ.get("CKO_PALLAS_FINALS", "0") == "1"
-_FINALS_BLOCK_T = 32
+_FINALS_BLOCK_T = 128  # row tile; t must be a multiple (or a small power of two)
 
 # Above this Q the NCE prefix sum uses jnp.cumsum instead of a [Q, Q]
 # triangular matmul — the table is O(Q²) HBM and on long-body buckets
@@ -73,11 +75,13 @@ _FINALS_BLOCK_T = 32
 _NCE_MATMUL_MAX_Q = 512
 
 
-def _use_pallas_finals(t: int, n_cols: int) -> bool:
+def _use_pallas_finals(t: int, n_cols: int, n_channels: int, n_groups_f: int) -> bool:
     return (
         _PALLAS_FINALS
-        and t % _FINALS_BLOCK_T == 0
+        and (t % _FINALS_BLOCK_T == 0 or (t < _FINALS_BLOCK_T and t % 8 == 0))
         and n_cols >= 128
+        and n_channels <= 128
+        and n_groups_f <= 512
         and jax.default_backend() == "tpu"
     )
 
@@ -343,6 +347,23 @@ def _latch_min(vals: jnp.ndarray, big, forward: bool) -> jnp.ndarray:
     return y
 
 
+def _window_min(vals: jnp.ndarray, lo: int, hi: int, big, forward: bool) -> jnp.ndarray:
+    """Windowed min along axis 1: out[p] = min over d ∈ [lo, hi] of
+    vals[p + d] (forward) / vals[p - d] (backward). Doubling spans plus
+    one patch-up pass — O(log(hi - lo)) elementwise mins, the min-domain
+    mirror of ``_spread_or``."""
+    sgn = 1 if forward else -1
+    width = hi - lo + 1
+    y = vals
+    span = 1
+    while span * 2 <= width:
+        y = jnp.minimum(y, _shift3_fill(y, sgn * span, big))
+        span *= 2
+    if span < width:
+        y = jnp.minimum(y, _shift3_fill(y, sgn * (width - span), big))
+    return _shift3_fill(y, sgn * lo, big)
+
+
 def _lshift_fill(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
     if k == 0:
         return x
@@ -501,7 +522,9 @@ def match_segment_block(
     # the Pallas kernel computes them itself with a K = W*C im2col
     # matmul, so m_all below covers only columns [off, N2).
     n_finals_cols = sum(len(items) for items in finals.values())
-    pallas_finals = n_finals_cols > 0 and _use_pallas_finals(t, n_finals_cols)
+    pallas_finals = n_finals_cols > 0 and _use_pallas_finals(
+        t, n_finals_cols, len(spec.channels), len(finals)
+    )
     off = n_finals_cols if pallas_finals else 0
 
     # 2. conv: all segments, all start positions. out[t, p, n] == 2W ⇔
@@ -599,13 +622,25 @@ def match_segment_block(
             ) == 0
 
         if hi >= 0:
-            acc = jnp.zeros_like(x)
-            for d in range(lo, hi + 1):
-                if forward:
-                    acc = acc | (_lshift3(x, d) & clean(d))
-                else:
-                    acc = acc | _rshift3(x & clean(d), d)
-            return acc
+            if hi - lo + 1 <= 8:
+                # Narrow window: shift-unrolled ORs beat the log passes.
+                acc = jnp.zeros_like(x)
+                for d in range(lo, hi + 1):
+                    if forward:
+                        acc = acc | (_lshift3(x, d) & clean(d))
+                    else:
+                        acc = acc | _rshift3(x & clean(d), d)
+                return acc
+            # Wide bounded window (CRS-grade .{0,60} class gaps): the
+            # clean-span test "NCE[p'] == NCE[p]" (NCE is non-decreasing,
+            # so candidates can never dip below) bounded to the window
+            # [p+lo, p+hi] via an O(log span) windowed min — exact, and
+            # ~span/log(span) fewer passes than the unrolled form.
+            if forward:
+                m = _window_min(jnp.where(x, nce3, big), lo, hi, big, forward=True)
+                return m == nce3
+            m = -_window_min(jnp.where(x, -nce3, big), lo, hi, big, forward=False)
+            return m == nce3
         if forward:
             x1 = _lshift3(x, lo) & clean(lo) if lo else x
             h = _latch_min(jnp.where(x1, nce3, big), big, forward=True)
@@ -755,14 +790,26 @@ def match_segment_block(
                 # Prefilter gate (as in the bucketed tier): if none of this
                 # group's first segments matched anywhere in the block, skip
                 # the AND-any reduction entirely — benign-heavy traffic pays
-                # only the cheap any() read.
+                # only the cheap any() read. ONLY for small column groups:
+                # the any() itself is a full read of the slice, and a
+                # many-hundred-column group in a serving-sized batch almost
+                # always has some hit somewhere, so the gate would pay a
+                # whole extra [T, Q, NB] pass (profiled at ~1.1 ms/step as
+                # fusion.406) to skip nothing.
                 def run_final(_, m=m, gj=gj):
                     return jnp.any(m & gj[:, :, None], axis=1)  # [T, NB]
 
-                no_match = jnp.broadcast_to(m_all[:, 0, :1] & False, (t, a1 - a0))
-                cols.append(
-                    jax.lax.cond(jnp.any(m), run_final, lambda _, z=no_match: z, None)
-                )
+                if a1 - a0 > 64:
+                    cols.append(run_final(None))
+                else:
+                    no_match = jnp.broadcast_to(
+                        m_all[:, 0, :1] & False, (t, a1 - a0)
+                    )
+                    cols.append(
+                        jax.lax.cond(
+                            jnp.any(m), run_final, lambda _, z=no_match: z, None
+                        )
+                    )
         for items in finals.values():
             col_groups.extend(spec.branches[bi][0] for bi, _ in items)
         bh_all = jnp.concatenate(cols, axis=1)

@@ -1,26 +1,36 @@
-"""Pallas TPU kernel for the conv-segment FINALS tier.
+"""Pallas TPU kernel for the conv-segment FINALS tier (v2).
 
-The XLA path computes the finals (suffix-deduped branches' first
-segments) as part of one big ``conv_general_dilated`` whose contraction
-dim is only C≈26 channels — ~20% of the MXU's 128 K-lanes — and then
-re-reads the [T, Q, N] match scores for the AND-any reduction (~1.3 GB
-at serving shapes). This tier instead:
+Why: at serving shapes the XLA conv path is bandwidth-bound, not
+FLOP-bound — the profiler shows ``convolution_compare_fusion`` touching
+~1.4 GB per step (XLA re-reads the embed per output-channel tile) plus a
+second giant pass (``fusion.406``) re-reading the whole [T, Q, N] match
+bitmap just to slice the finals columns for their AND-any reduction.
+The finals columns (in CRS-shaped rulesets: ~97% of all conv columns)
+only need ``any over Q`` per (row, column) — the [T, Q, N] bitmap is
+pure waste for them.
 
-1. builds im2col patches ``[T·Q, W·C]`` once in XLA (bf16, ~1 GB at
-   serving shapes — cheap next to the reads it removes; an in-VMEM
-   concat was tried first but Mosaic rejects lane-unaligned concats of
-   C=26 slices);
-2. runs ONE fused Pallas kernel per (targets × columns) tile in which
-   EVERY step is a matmul — no in-kernel reshapes (merging the
-   sublane-unaligned (Tt, Q) dims forced a relayout that made a first
-   version 10x slower than XLA):
-   - patches @ weights (K = W·C ≈ 442 → near MXU peak) + threshold
-     (score == 2W ⇔ segment match at that window);
-   - reachability-AND via a tiny [Gf, Nt] one-hot matmul broadcasting
-     each branch group's suffix vector to its columns;
-   - the any-over-Q reduction as a static block-diagonal [Tt, Tt·Q]
-     0/1 matmul (exact in bf16: counts ≤ Q ≪ 256).
-   The [T, Q, N] match bitmap never exists in HBM and is never re-read.
+v1 (round 2) fused threshold+AND+reduce into one kernel but needed
+im2col patches built in XLA, and the C=26 lane-unaligned channel concat
+relayouted catastrophically (~27 ms). v2 removes patches entirely with a
+residue-block decomposition:
+
+1. XLA side: pad channels C → C32 ∈ {32, 64, 128}; flatten the embed to
+   ``eflat [T, Lp·C32]``; for each residue r in 0..R-1 (R = 128/C32)
+   shift by ``C32·r`` lanes and reshape FREE (row-major) to
+   ``e3_r [T, Lr, 128]``. The window for position p = R·q + r is then
+   exactly ``nblk`` CONSECUTIVE 128-lane blocks of ``e3_r`` starting at
+   block q — im2col becomes block indexing.
+2. Kernel: for each (row-tile, column-tile), positions iterate as a
+   ``fori_loop``; each position's score is ``nblk`` accumulated
+   [Tt, 128] × [128, Nt] matmuls (full-K MXU passes), thresholded at
+   2W, ANDed with the per-group reachability row (one tiny [Tt, Gf] ×
+   [Gf, Nt] one-hot matmul), and summed into the [Tt, Nt] counts
+   accumulator. The [T, Q, N] bitmap never exists anywhere.
+
+The kernel weights are IDENTICAL for every residue and position
+(``Kblk[j, l, n] = Kflat[128·j + l, n]``) because the per-residue lane
+shift already absorbed the ``C32·r`` offset — that is the point of the
+residue trick.
 
 CPU tests run in interpreter mode on small shapes; eligibility and the
 XLA fallback live in ``ops/segment.py``.
@@ -42,40 +52,57 @@ def _round_up(n: int, m: int) -> int:
     return (n + m - 1) // m * m
 
 
-def _finals_kernel(patches_ref, weights_ref, g2_ref, sel_ref, rowsel_ref, out_ref, *, w):
-    """One (i, j) tile: [Tt] targets x [Nt] finals columns, M = Tt*Q rows.
+def _finals_kernel(
+    e3_refs,  # R refs: [Tt, Lr, 128] bf16 residue-shifted embed blocks
+    g_refs,  # R refs: [Tt, QRp, GFp] bf16 reachability rows for p≡r (mod R)
+    kblk_ref,  # [nblk, 128, Nt] bf16 kernel blocks (shared by all r, q)
+    sel_ref,  # [GFp, Nt] bf16 one-hot group -> column
+    out_ref,  # [Tt, Nt] int32 counts (>0 ⇔ column matched at some position)
+    *,
+    w: int,
+    nblk: int,
+):
+    thr = jnp.float32(2.0 * w)
+    tt = out_ref.shape[0]
+    nt = out_ref.shape[1]
+    acc = jnp.zeros((tt, nt), dtype=jnp.float32)
 
-    patches_ref: [M, Kp] bf16 im2col windows (K = W*C zero-padded);
-    weights_ref: [Kp, Nt] bf16 segment kernel columns;
-    g2_ref: [M, Gf] bf16 per-group reachability rows (window-start order);
-    sel_ref: [Gf, Nt] bf16 one-hot column -> group;
-    rowsel_ref: [Tt, M] bf16 block-diagonal row -> target map;
-    out_ref: [Tt, Nt] int32 (0/1 column verdicts).
-    """
-    scores = jnp.dot(
-        patches_ref[...], weights_ref[...], preferred_element_type=jnp.float32
-    )  # [M, Nt]
-    m = scores >= jnp.float32(2.0 * w)
-    g = (
-        jnp.dot(g2_ref[...], sel_ref[...], preferred_element_type=jnp.float32)
-        > 0
-    )  # [M, Nt]
-    mg = (m & g).astype(jnp.bfloat16)
-    counts = jnp.dot(
-        rowsel_ref[...], mg, preferred_element_type=jnp.float32
-    )  # [Tt, Nt]
-    out_ref[...] = (counts > 0).astype(jnp.int32)
+    # Per residue: nblk BIG dots (M = Tt·lr8 — the [Tt, lr8, 128] block
+    # reshapes for free because lr8 is a multiple of 8, so tile
+    # boundaries are preserved), then a shifted 3D accumulation maps
+    # row qq+j of dot j to position qq. A first version looped positions
+    # with [Tt, 128] dots — ~200 latency-bound small matmuls per tile
+    # ran 3.5x slower than this form.
+    for r in range(len(e3_refs)):
+        e3 = e3_refs[r]
+        g = g_refs[r]
+        lr8 = e3.shape[1]
+        qr8 = g.shape[1]
+        e2 = e3[...].reshape(tt * lr8, _LANE)
+        acc3 = jnp.zeros((tt, qr8, nt), dtype=jnp.float32)
+        for j in range(nblk):
+            s_j = jnp.dot(
+                e2, kblk_ref[j], preferred_element_type=jnp.float32
+            ).reshape(tt, lr8, nt)
+            acc3 = acc3 + jax.lax.slice_in_dim(s_j, j, j + qr8, axis=1)
+        g2 = g[...].reshape(tt * qr8, g.shape[2])
+        gcols = jnp.dot(
+            g2, sel_ref[...], preferred_element_type=jnp.float32
+        ).reshape(tt, qr8, nt)
+        hit = (acc3 >= thr) & (gcols > 0)  # [Tt, qr8, Nt]
+        acc = acc + jnp.sum(hit.astype(jnp.float32), axis=1)
+    out_ref[...] = (acc > 0).astype(jnp.int32)
 
 
 def finals_match(
     embed: jnp.ndarray,  # [T, Lp, C] bf16 channel planes (Lp = 1 + L + W)
     weights: jnp.ndarray,  # [W*C, Nf] bf16 (finals columns of the conv kernel)
-    gj: jnp.ndarray,  # [T, Q, Gf] bf16 per-group reachability
+    gj: jnp.ndarray,  # [T, Q, Gf] bf16 per-group reachability (window-start)
     sel: np.ndarray,  # [Gf, Nf] one-hot column -> group (host constant)
     *,
     w: int,
     q: int,
-    block_t: int = 32,
+    block_t: int = 64,
     block_n: int = 256,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -83,47 +110,99 @@ def finals_match(
     t, lp, c = embed.shape
     nf = weights.shape[1]
     gf = gj.shape[2]
-    kp = _round_up(w * c, _LANE)
-    np_cols = _round_up(max(nf, block_n), block_n)
-    m_rows = block_t * q
+    c32 = 32 if c <= 32 else (64 if c <= 64 else 128)
+    assert c <= 128, "pallas finals tier requires C <= 128 channels"
+    r_count = _LANE // c32
+    nblk = (w * c32 + _LANE - 1) // _LANE
+    block_t = min(block_t, t)
+    # np_cols must be a multiple of block_n for the (i, j) grid.
+    np_cols = _round_up(nf, block_n) if nf > block_n else _round_up(nf, _LANE)
+    block_n = min(block_n, np_cols)
+    gfp = _round_up(gf, _LANE)
 
-    # im2col in XLA: W shifted channel-plane views, zero-padded to Kp,
-    # flattened to [T*Q, Kp] (row-major — contiguous, no relayout).
-    patches = jnp.concatenate(
-        [embed[:, wi : wi + q, :] for wi in range(w)], axis=-1
-    )  # [T, Q, W*C]
-    patches = jnp.pad(patches, ((0, 0), (0, 0), (0, kp - w * c))).reshape(
-        t * q, kp
-    )
-    g2 = gj.reshape(t * q, gf)
+    # Row geometry: qr8/lr8 are multiples of 8 so the kernel's
+    # [Tt, lr8, 128] -> [Tt*lr8, 128] reshape preserves tile boundaries
+    # (free); lr8 also covers the j-shifted slices (qr8 + nblk - 1).
+    qrs0 = tuple((q - r + r_count - 1) // r_count for r in range(r_count))
+    qr8 = _round_up(max(qrs0), 8)
+    lr8 = _round_up(qr8 + nblk - 1, 8)
 
-    weights_p = jnp.pad(
-        weights.astype(jnp.bfloat16), ((0, kp - w * c), (0, np_cols - nf))
-    )
+    # Shrink the tile until the working set fits scoped VMEM (~16M):
+    # double-buffered inputs plus the kernel's [Tt, qr8, Nt] f32
+    # temporaries (acc3 / s_j / gcols).
+    while True:
+        est = 2 * (
+            r_count * block_t * lr8 * _LANE * 2
+            + r_count * block_t * qr8 * gfp * 2
+            + nblk * _LANE * block_n * 2
+            + gfp * block_n * 2
+            + block_t * block_n * 4
+        ) + 3 * block_t * qr8 * block_n * 4
+        if est <= 12 * 1024 * 1024 or (block_t <= 8 and block_n <= 128):
+            break
+        if block_t > 8:
+            block_t //= 2
+        else:
+            block_n //= 2
+            np_cols = _round_up(nf, block_n)
+    if t % block_t != 0:
+        block_t = t  # small odd row buckets: single tile
+
+    # --- XLA prep (all cheap: pads, one lane shift per residue, free
+    # row-major reshapes) ---
+    ep = jnp.pad(embed, ((0, 0), (0, 0), (0, c32 - c)))  # [T, Lp, C32]
+    eflat = ep.reshape(t, lp * c32)
+    e3s = []
+    gs = []
+    for r in range(r_count):
+        er = eflat[:, c32 * r :]
+        need = lr8 * _LANE
+        er = jnp.pad(er, ((0, 0), (0, max(0, need - er.shape[1]))))[:, :need]
+        e3s.append(er.reshape(t, lr8, _LANE))
+        g_r = gj[:, r::r_count, :]  # [T, qr, Gf]
+        g_r = jnp.pad(
+            g_r,
+            ((0, 0), (0, qr8 - g_r.shape[1]), (0, gfp - gf)),
+        )
+        gs.append(g_r)
+
+    wf = weights.reshape(w, c, nf)
+    wf = jnp.pad(wf, ((0, 0), (0, c32 - c), (0, 0)))  # [W, C32, Nf]
+    kflat = jnp.pad(
+        wf.reshape(w * c32, nf),
+        ((0, nblk * _LANE - w * c32), (0, np_cols - nf)),
+    ).astype(jnp.bfloat16)
+    kblk = kflat.reshape(nblk, _LANE, np_cols)
     sel_p = jnp.asarray(
-        np.pad(sel, ((0, 0), (0, np_cols - nf))), dtype=jnp.bfloat16
+        np.pad(np.asarray(sel, dtype=np.float32), ((0, gfp - gf), (0, np_cols - nf))),
+        dtype=jnp.bfloat16,
     )
-    rowsel = np.zeros((block_t, m_rows), dtype=np.float32)
-    for ti in range(block_t):
-        rowsel[ti, ti * q : (ti + 1) * q] = 1.0
-    rowsel_b = jnp.asarray(rowsel, dtype=jnp.bfloat16)
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    kernel = functools.partial(_finals_kernel, w=w)
+    kernel = functools.partial(_finals_kernel, w=w, nblk=nblk)
+
+    def kernel_entry(*refs):
+        e3_refs = refs[:r_count]
+        g_refs = refs[r_count : 2 * r_count]
+        kblk_ref, sel_ref, out_ref = refs[2 * r_count :]
+        kernel(e3_refs, g_refs, kblk_ref, sel_ref, out_ref)
+
+    in_specs = (
+        [pl.BlockSpec((block_t, lr8, _LANE), lambda i, j: (i, 0, 0))] * r_count
+        + [pl.BlockSpec((block_t, qr8, gfp), lambda i, j: (i, 0, 0))] * r_count
+        + [
+            pl.BlockSpec((nblk, _LANE, block_n), lambda i, j: (0, 0, j)),
+            pl.BlockSpec((gfp, block_n), lambda i, j: (0, j)),
+        ]
+    )
     out = pl.pallas_call(
-        kernel,
+        kernel_entry,
         grid=(t // block_t, np_cols // block_n),
-        in_specs=[
-            pl.BlockSpec((m_rows, kp), lambda i, j: (i, 0)),
-            pl.BlockSpec((kp, block_n), lambda i, j: (0, j)),
-            pl.BlockSpec((m_rows, gf), lambda i, j: (i, 0)),
-            pl.BlockSpec((gf, block_n), lambda i, j: (0, j)),
-            pl.BlockSpec((block_t, m_rows), lambda i, j: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((block_t, block_n), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((t, np_cols), jnp.int32),
         interpret=interpret,
-    )(patches, weights_p, g2, sel_p, rowsel_b)
+    )(*e3s, *gs, kblk, sel_p)
     return out[:, :nf] != 0

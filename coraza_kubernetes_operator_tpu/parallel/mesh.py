@@ -175,6 +175,8 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
         e_lg=jnp.asarray(e_lg),
         m_count=base.m_count,
         link_count=base.link_count,
+        e_numvar=base.e_numvar,
+        e_counter=base.e_counter,
         link_matrix=base.link_matrix,
         link_mask=base.link_mask,
         decision=base.decision,

@@ -23,6 +23,7 @@
 #include <memory>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -1004,12 +1005,166 @@ struct RegexKind {
 };
 
 struct NumVarSpec {
-  uint8_t type;  // 0 scalar, 1 count
+  uint8_t type;  // 0 scalar, 1 count, 2 host op (sqli/xss)
   uint8_t scalar_id = 0;
   uint8_t coll = 0;
   bool has_sel = false;
   bytes sel;  // lowercased
+  // type == 2 (host-evaluated operator over transformed targets):
+  uint8_t op_id = 0;  // 0 = sqli (libinjection-architecture)
+  std::vector<uint8_t> pipe_ops;           // transform chain
+  std::vector<uint8_t> inc_kinds, exc_kinds;  // bitmasks over kind ids
 };
+
+// ---------------------------------------------------------------------------
+// libinjection-architecture SQLi machine (compiler/sqli.py port).
+// The word-class map and fingerprint table arrive IN the config blob —
+// generated by the Python module, so table and tokenizer can never
+// skew; the tokenizer itself is differentially tested via cko_sqli().
+// ---------------------------------------------------------------------------
+
+struct SqliTables {
+  std::unordered_map<bytes, char> words;  // lowercased word -> type char
+  std::unordered_set<bytes> fps;          // folded 5-type fingerprints
+};
+
+static inline bool sq_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+static inline bool sq_digit(char c) { return c >= '0' && c <= '9'; }
+static inline bool sq_hexd(char c) {
+  return sq_digit(c) || (c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F');
+}
+static inline bool sq_opchar(char c) {
+  switch (c) {
+    case '+': case '-': case '*': case '/': case '%': case '=': case '<':
+    case '>': case '!': case '^': case '~': case '|': case '&': case ':':
+      return true;
+    default:
+      return false;
+  }
+}
+static inline bool sq_wordchar(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || sq_digit(c) ||
+         c == '_' || c == '$' || c == '.' || c == '@' || c == '#';
+}
+
+static char sq_classify(const SqliTables& T, const bytes& word) {
+  bytes lw = lower(word);
+  size_t a = 0, b = lw.size();
+  while (a < b && lw[a] == '.') a++;
+  while (b > a && lw[b - 1] == '.') b--;
+  auto it = T.words.find(lw.substr(a, b - a));
+  return it == T.words.end() ? 'v' : it->second;
+}
+
+// Exact port of compiler/sqli.py:tokenize (type chars only — fold reads
+// nothing else; '&&'/'||' classification happens here as in Python).
+static void sq_tokenize(const SqliTables& T, const bytes& s,
+                        std::string& out) {
+  size_t i = 0, n = s.size();
+  size_t emitted0 = out.size();
+  while (i < n && out.size() - emitted0 < 32) {
+    char c = s[i];
+    if (sq_space(c)) { i++; continue; }
+    if ((c == '-' && i + 1 < n && s[i + 1] == '-') || c == '#') {
+      out.push_back('c');
+      break;
+    }
+    if (c == '/' && i + 1 < n && s[i + 1] == '*') {
+      size_t end = s.find("*/", i + 2);
+      if (end == bytes::npos) { out.push_back('c'); break; }
+      if (i + 2 < n && s[i + 2] == '!') {
+        bytes body = s.substr(i + 3, end - (i + 3));
+        size_t k = 0;
+        while (k < body.size() && sq_digit(body[k])) k++;
+        sq_tokenize(T, body.substr(k), out);
+      }
+      i = end + 2;
+      continue;
+    }
+    if (c == '\'' || c == '"' || c == '`') {
+      size_t j = i + 1;
+      while (j < n) {
+        if (s[j] == '\\') { j += 2; continue; }
+        if (s[j] == c) break;
+        j++;
+      }
+      out.push_back('s');
+      i = j + 1;
+      continue;
+    }
+    if (sq_digit(c) || (c == '.' && i + 1 < n && sq_digit(s[i + 1]))) {
+      size_t j = i;
+      if (c == '0' && i + 1 < n && (s[i + 1] == 'x' || s[i + 1] == 'X')) {
+        j = i + 2;
+        while (j < n && sq_hexd(s[j])) j++;
+      } else {
+        while (j < n && (sq_digit(s[j]) || s[j] == '.' || s[j] == 'e' ||
+                         s[j] == 'E'))
+          j++;
+      }
+      out.push_back('n');
+      i = j;
+      continue;
+    }
+    if (c == '(' || c == ')' || c == ',' || c == ';') {
+      out.push_back(c);
+      i++;
+      continue;
+    }
+    if (c == '@') {
+      size_t j = i;
+      while (j < n && (s[j] == '@' || sq_wordchar(s[j]))) j++;
+      out.push_back('v');
+      i = j;
+      continue;
+    }
+    if (sq_opchar(c)) {
+      size_t j = i;
+      while (j < n && sq_opchar(s[j]) && j - i < 3) j++;
+      bytes text = s.substr(i, j - i);
+      out.push_back(text == "&&" || text == "||" ? '&' : 'o');
+      i = j;
+      continue;
+    }
+    if (sq_wordchar(c)) {
+      size_t j = i;
+      while (j < n && sq_wordchar(s[j])) j++;
+      out.push_back(sq_classify(T, s.substr(i, j - i)));
+      i = j;
+      continue;
+    }
+    out.push_back('x');
+    i++;
+  }
+}
+
+static std::string sq_fold(const std::string& types) {
+  std::string out;
+  for (char t : types) {
+    if (!out.empty()) {
+      char prev = out.back();
+      if (t == prev && (t == 'v' || t == 's' || t == 'c')) continue;
+      if (t == 'o' && prev == 'o') continue;
+    }
+    out.push_back(t);
+  }
+  return out;
+}
+
+static bool sq_is_sqli(const SqliTables& T, const bytes& value) {
+  if (value.size() < 3) return false;
+  const bytes ctxs[3] = {value, "'" + value, "\"" + value};
+  for (const bytes& ctx : ctxs) {
+    std::string types;
+    sq_tokenize(T, ctx, types);
+    std::string fp = sq_fold(types).substr(0, 5);
+    if (!fp.empty() && T.fps.count(fp)) return true;
+  }
+  return false;
+}
 
 struct Pipeline {
   std::vector<uint8_t> ops;
@@ -1027,6 +1182,8 @@ struct Ctx {
   uint32_t numeric_kind[N_COUNT_] = {0};
   std::vector<Pipeline> pipelines;  // host pipelines in slot order
   std::vector<NumVarSpec> numvars;
+  bool has_hostops = false;
+  SqliTables sqli;
 };
 
 struct Reader {
@@ -1151,19 +1308,63 @@ void* cko_ctx_new(const uint8_t* blob, size_t len) {
     nv.type = r.u8();
     if (nv.type == 0) {
       nv.scalar_id = r.u8();
-    } else {
+    } else if (nv.type == 1) {
       nv.coll = r.u8();
       nv.has_sel = r.u8() != 0;
       uint16_t sl = r.u16();
       if (r.p + sl > r.end) { r.ok = false; break; }
       nv.sel = bytes((const char*)r.p, sl);
       r.p += sl;
+    } else {  // type 2: host-evaluated operator (sqli)
+      nv.op_id = r.u8();
+      uint32_t n_ops = r.u32();
+      for (uint32_t j = 0; j < n_ops && r.ok; j++)
+        nv.pipe_ops.push_back(r.u8());
+      nv.inc_kinds.assign(ctx->n_kinds + 1, 0);
+      nv.exc_kinds.assign(ctx->n_kinds + 1, 0);
+      uint32_t n_inc = r.u32();
+      for (uint32_t j = 0; j < n_inc && r.ok; j++) {
+        uint32_t kid = r.u32();
+        if (kid < nv.inc_kinds.size()) nv.inc_kinds[kid] = 1;
+      }
+      uint32_t n_exc = r.u32();
+      for (uint32_t j = 0; j < n_exc && r.ok; j++) {
+        uint32_t kid = r.u32();
+        if (kid < nv.exc_kinds.size()) nv.exc_kinds[kid] = 1;
+      }
+      ctx->has_hostops = true;
     }
     ctx->numvars.push_back(std::move(nv));
   }
 
+  // SQLi tables (present iff any hostop entry exists): word-class map +
+  // fingerprint set, generated by compiler/sqli.py.
+  if (ctx->has_hostops && r.ok) {
+    uint32_t n_words = r.u32();
+    for (uint32_t i = 0; i < n_words && r.ok; i++) {
+      uint16_t wl = r.u16();
+      if (r.p + wl + 1 > r.end) { r.ok = false; break; }
+      bytes w((const char*)r.p, wl);
+      r.p += wl;
+      ctx->sqli.words[w] = (char)r.u8();
+    }
+    uint32_t n_fps = r.u32();
+    for (uint32_t i = 0; i < n_fps && r.ok; i++) {
+      uint8_t fl = r.u8();
+      if (r.p + fl > r.end) { r.ok = false; break; }
+      ctx->sqli.fps.insert(bytes((const char*)r.p, fl));
+      r.p += fl;
+    }
+  }
+
   if (!r.ok) return nullptr;
   return ctx.release();
+}
+
+// Differential-test export: run the native SQLi machine standalone.
+int cko_sqli(void* h, const uint8_t* s, size_t n) {
+  Ctx* ctx = (Ctx*)h;
+  return sq_is_sqli(ctx->sqli, bytes((const char*)s, n)) ? 1 : 0;
 }
 
 void cko_ctx_free(void* h) { delete (Ctx*)h; }
@@ -1354,7 +1555,7 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
         nv[vi] = spec.scalar_id < N_COUNT_
                      ? (int32_t)numeric_vals[spec.scalar_id]
                      : 0;  // unknown scalar evaluates to 0 (python parity)
-      } else {
+      } else if (spec.type == 1) {
         int32_t count = 0;
         for (auto& t : targets) {
           if (t.coll != spec.coll) continue;
@@ -1362,8 +1563,10 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
         }
         nv[vi] = count;
       }
+      // type 2 (host ops) filled in the kind-resolution loop below.
     }
     res->numvals.push_back(std::move(nv));
+    std::vector<int32_t>& nv_ref = res->numvals.back();
 
     // kind resolution + row packing (waf.py:_tensorize)
     size_t body_cap = std::max<size_t>(32, ctx->body_limit);
@@ -1389,6 +1592,30 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
       }
       if (nk == 0) continue;
       bytes value = t.value.substr(0, body_cap);
+
+      // Host-evaluated operators (engine/request.py:_eval_hostop): a
+      // target whose kind set meets include (and misses exclude) runs
+      // the op's transform chain + detector; any hit latches the bit.
+      if (ctx->has_hostops) {
+        for (size_t vi = 0; vi < ctx->numvars.size(); vi++) {
+          const NumVarSpec& spec = ctx->numvars[vi];
+          if (spec.type != 2 || nv_ref[vi]) continue;
+          bool inc = false, exc = false;
+          for (int k = 0; k < nk; k++) {
+            int32_t kid = kinds[k];
+            if (kid <= 0) continue;
+            if ((size_t)kid < spec.inc_kinds.size() && spec.inc_kinds[kid])
+              inc = true;
+            if ((size_t)kid < spec.exc_kinds.size() && spec.exc_kinds[kid])
+              exc = true;
+          }
+          if (!inc || exc) continue;
+          bytes v = t.value;  // full value (python applies pipeline pre-cap)
+          for (uint8_t op : spec.pipe_ops) v = apply_op(op, v);
+          if (spec.op_id == 0 && sq_is_sqli(ctx->sqli, v)) nv_ref[vi] = 1;
+        }
+      }
+
       for (int off = 0; off < nk; off += 3) {
         Row row;
         row.req = req;

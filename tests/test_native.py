@@ -191,3 +191,80 @@ def test_transform_parity_exhaustive():
             assert (np.asarray(py[7]) == np.asarray(nat[7])).all(), (
                 name, case, apply_pipeline(case, names),
             )
+
+
+def test_native_sqli_differential():
+    """The C++ SQLi machine (cko_sqli) must agree with compiler/sqli.py
+    byte-for-byte: same tokenizer semantics, blob-shipped tables."""
+    from coraza_kubernetes_operator_tpu.compiler.sqli import (
+        _ATTACK_CORPUS,
+        is_sqli,
+    )
+    from coraza_kubernetes_operator_tpu.native import (
+        load_library,
+        serialize_config,
+    )
+
+    rules = (
+        "SecRuleEngine On\n"
+        'SecRule ARGS "@detectSQLi" "id:1,phase:2,deny,status:403,t:none,t:urlDecodeUni"\n'
+    )
+    crs = compile_rules(rules)
+    lib = load_library()
+    assert lib is not None
+    blob = serialize_config(crs)
+    assert blob is not None, "hostop ruleset must serialize natively now"
+    ctx = lib.cko_ctx_new(blob, len(blob))
+    assert ctx
+
+    benign = [
+        "hello world", "the quick brown fox", "1 plus 1", "a=1&b=2",
+        "O'Brien", "12:30pm", "path/to/file.txt", "x" * 50, "",
+        "select a seat", "drop me a line", "union station",
+        "I'd like 2 to 1 odds", "price > 100 and color = blue?",
+    ]
+    rng = random.Random(3)
+    fuzz = []
+    alpha = string.printable
+    for _ in range(400):
+        fuzz.append("".join(rng.choice(alpha) for _ in range(rng.randrange(0, 40))))
+    try:
+        for s in _ATTACK_CORPUS + benign + fuzz:
+            b = s.encode("latin-1", "replace")
+            want = is_sqli(b)[0]
+            got = lib.cko_sqli(ctx, b, len(b)) == 1
+            assert got == want, (s, want, got)
+    finally:
+        lib.cko_ctx_free(ctx)
+
+
+def test_native_sqli_ruleset_verdict_parity():
+    """End-to-end: a @detectSQLi ruleset runs on the native tensorizer and
+    produces identical verdicts to the python extraction path."""
+    rules = (
+        "SecRuleEngine On\n"
+        'SecDefaultAction "phase:2,log,deny,status:403"\n'
+        'SecRule ARGS "@detectSQLi" "id:900,phase:2,deny,status:403,t:none,t:urlDecodeUni"\n'
+    )
+    eng = WafEngine(rules)
+    assert eng.native_enabled, "detectSQLi ruleset must ride the native path"
+    reqs = [
+        HttpRequest(uri="/?q=hello"),
+        HttpRequest(uri="/?q=1%27%20or%20%271%27%3D%271"),
+        HttpRequest(uri="/?q=union+select+password+from+users"),
+        HttpRequest(uri="/?name=O%27Brien"),
+    ]
+    native_verdicts = eng.evaluate(reqs)
+    import coraza_kubernetes_operator_tpu.engine.waf as waf_mod
+
+    saved = eng._native
+    class _Off:
+        available = False
+    eng._native = _Off()
+    try:
+        python_verdicts = eng.evaluate(reqs)
+    finally:
+        eng._native = saved
+    assert [v.interrupted for v in native_verdicts] == [
+        v.interrupted for v in python_verdicts
+    ] == [False, True, True, False]

@@ -39,9 +39,16 @@ PATTERNS = [
     (r"information_schema", True),
     (r"\$\(.*\)", False),
     (r";\s*(cat|ls|id|whoami)\b", True),
+    # CRS-grade shapes: wide bounded class gaps (windowed-min path) and
+    # alternation products
+    (r"select\b[^;]{0,40}\bfrom", True),
+    (r"<(img|svg|iframe)[^>]{0,60}(onerror|onload)\s*=", True),
+    (r"\b(select|update|delete)\b.{2,50}\b(from|where)\b", True),
 ]
 
 WORDS = [
+    "<img ", "src=x ", "onerror", "=y", "from", "where", "update ", ";;",
+    "a"*45, "<svg "," onload", "delete ",
     "union", "select", "all", "from", "attack42x7", "or", "and", "sleep",
     "<script", ">", "=", "1", "23", " ", "  ", "\t", "evilmonkey", "../",
     "etc/passwd", "javascript:", "aab", "aaaab", "x123y", "x12y", "abc",
@@ -183,7 +190,7 @@ def test_pallas_finals_matches_xla_path(monkeypatch):
 
     ref = S.match_segment_block(blk.kernel, blk.spec, jnp.asarray(data), jnp.asarray(lengths))
 
-    monkeypatch.setattr(S, "_use_pallas_finals", lambda t, n: True)
+    monkeypatch.setattr(S, "_use_pallas_finals", lambda *a: True)
     jax.clear_caches()
     try:
         got = S.match_segment_block(
