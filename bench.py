@@ -65,11 +65,15 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     @jax.jit
     def serve(tiers, numvals):
         def chunk(i):
-            first, *rest = tiers
-            d = first[0].at[0, 0].set(i.astype(jnp.uint8))
-            out = eval_waf_tiered.__wrapped__(
-                m, ((d,) + tuple(first[1:]),) + tuple(rest), numvals
+            # Perturb EVERY tier's buffer: lax.map hoists loop-invariant
+            # subgraphs, so an untouched tier would be evaluated once per
+            # dispatch instead of once per chunk and the number would
+            # measure only the perturbed tier's marginal work.
+            perturbed = tuple(
+                (t[0].at[0, 0].set(i.astype(jnp.uint8)),) + tuple(t[1:])
+                for t in tiers
             )
+            out = eval_waf_tiered.__wrapped__(m, perturbed, numvals)
             return out["interrupted"].sum()
 
         return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
@@ -228,8 +232,13 @@ def _config_3(iters, n_chunks, n_rules):
     # tensorize+tier cost is reported separately (tensorize_s covers the
     # whole batch once).
     lat_iters = int(os.environ.get("BENCH_LAT_ITERS", "100"))
+    lat_points = [
+        int(b)
+        for b in os.environ.get("BENCH_LAT_POINTS", "1024,1536,2048").split(",")
+        if b.strip()
+    ]
     best = None
-    for lat_batch in (1024, 1536, 2048):
+    for lat_batch in lat_points:
         lat = _serve_throughput(eng, lat_batch, lat_iters, 16, requests=reqs[:lat_batch])
         entry = {
             "batch": lat_batch,

@@ -30,6 +30,52 @@ NUMERIC_OPS = {"eq", "ne", "ge", "gt", "le", "lt"}
 CMP_CODES = {"eq": 0, "ne": 1, "ge": 2, "gt": 3, "le": 4, "lt": 5}
 
 
+def _load_data_lines(arg: str, env: dict[str, str]) -> list[str]:
+    """Text lines of one-or-more data files (same resolution rules as
+    ``@pmFromFile``): ``#`` comments and blanks stripped."""
+    return [w.decode("latin-1", "replace") for w in _load_pm_file(arg, env)]
+
+
+def _ipmatch_regex(entries: list[str]) -> str:
+    """IPv4 addresses/CIDRs → anchored regex over the canonical dotted
+    quad (REMOTE_ADDR is produced by the engine's own extraction, so no
+    leading-zero/whitespace forms occur). Any CIDR decomposes into fixed
+    leading octets + at most one partial-octet range + wildcard tail —
+    each directly expressible as (tiny, prefix-shared) alternations that
+    the DFA interns compactly. (Reference: Coraza's @ipMatch; IPv6 is
+    rejected explicitly rather than silently un-matched.)"""
+    alts: list[str] = []
+    for entry in entries:
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" in entry:
+            raise UnsupportedOperator(f"@ipMatch: IPv6 not supported ({entry})")
+        addr, _, mask_s = entry.partition("/")
+        octets = addr.split(".")
+        if len(octets) != 4 or not all(o.isdigit() and int(o) <= 255 for o in octets):
+            raise UnsupportedOperator(f"@ipMatch: bad address {entry!r}")
+        mask = int(mask_s) if mask_s else 32
+        if not 0 <= mask <= 32:
+            raise UnsupportedOperator(f"@ipMatch: bad mask {entry!r}")
+        vals = [int(o) for o in octets]
+        parts: list[str] = []
+        full, rem = divmod(mask, 8)
+        for i in range(full):
+            parts.append(str(vals[i]))
+        if rem and full < 4:
+            lo = vals[full] & ~((1 << (8 - rem)) - 1)
+            hi = lo + (1 << (8 - rem)) - 1
+            parts.append("(?:" + "|".join(str(v) for v in range(lo, hi + 1)) + ")")
+            full += 1
+        for _ in range(full, 4):
+            parts.append(r"\d{1,3}")
+        alts.append(r"\.".join(parts))
+    if not alts:
+        raise UnsupportedOperator("@ipMatch: empty address list")
+    return "^(?:" + "|".join(alts) + ")$"
+
+
 def _load_pm_file(arg: str, env: dict[str, str]) -> list[bytes]:
     """Resolve and parse ``@pmFromFile`` data files (CRS ``*.data`` shape:
     one phrase per line, ``#`` comments, blank lines ignored). Relative
@@ -215,8 +261,14 @@ def lower_string_operator(op: Operator, env: dict[str, str]) -> StringOpPlan:
         # we can support them (gated on a configured data dir).
         words = _load_pm_file(arg, env)
         return StringOpPlan(pm_dfa(words), expanded_arg=arg)
-    if name == "ipmatchfromfile":
-        raise UnsupportedOperator("@ipmatchfromfile has no TPU lowering yet")
+    if name in ("ipmatch", "ipmatchfromfile"):
+        if name == "ipmatchfromfile":
+            entries = _load_data_lines(arg, env)
+        else:
+            entries = [e.strip() for e in arg.split(",") if e.strip()]
+        return StringOpPlan(
+            compile_regex_dfa(_ipmatch_regex(entries)), expanded_arg=arg
+        )
     if name == "detectsqli":
         return StringOpPlan(compile_regex_dfa(_DETECT_SQLI), approximate=True, expanded_arg=arg)
     if name == "detectxss":

@@ -159,6 +159,10 @@ class CompiledRule:
     severity: str | None = None
     tags: list[str] = field(default_factory=list)
     logs: bool = True
+    # Runtime ctl actions: when this rule matches, later rules whose id
+    # falls in a range (or carries a tag) are disabled for the request.
+    ctl_remove_ranges: list[tuple[int, int]] = field(default_factory=list)
+    ctl_remove_tags: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -169,6 +173,9 @@ class CompileReport:
 
     def skip(self, rule_id: int | None, reason: str) -> None:
         self.skipped.append((rule_id, reason))
+
+    def approximate(self, rule_id: int | None, reason: str) -> None:
+        self.approximations.append((rule_id, reason))
 
 
 @dataclass
@@ -486,12 +493,13 @@ class _Lowering:
         if op.name in NUMERIC_OPS:
             return self._lower_numeric_link(link, rule_id)
 
-        if op.name == "detectsqli":
-            # Host-evaluated libinjection-architecture detector
-            # (compiler/sqli.py): tokenizer+fingerprint semantics cannot
-            # lower to a regex, so the extractor computes a per-request
-            # bit over the rule's (transformed) targets and the device
-            # consumes it as a numeric link. Mirrors Coraza evaluating
+        if op.name in ("detectsqli", "detectxss"):
+            # Host-evaluated libinjection-architecture detectors
+            # (compiler/sqli.py tokenizer+fingerprint, compiler/xss.py
+            # html5 danger scan): their semantics cannot lower to a
+            # regex, so the extractor computes a per-request bit over
+            # the rule's (transformed) targets and the device consumes
+            # it as a numeric link. Mirrors Coraza evaluating
             # libinjection-go on the host CPU (reference go.mod:24).
             include: list[int] = []
             exclude: list[int] = []
@@ -503,8 +511,9 @@ class _Lowering:
                 (exclude if var.exclude else include).extend(kinds)
             if not include:
                 return None
+            opname = "sqli" if op.name == "detectsqli" else "xss"
             nv = self.numvars.intern(
-                ("hostop", "sqli", pipeline, tuple(include), tuple(exclude))
+                ("hostop", opname, pipeline, tuple(include), tuple(exclude))
             )
             self.links.append(
                 CompiledLink(
@@ -737,6 +746,33 @@ class _Lowering:
         defaults = self.program.default_actions.get(phase, [])
         decision, status = _decision_of(rule, defaults, 403)
         order_key = phase * 1_000_000 + seq
+        # ctl runtime actions (reference: Coraza's per-transaction rule
+        # removal; CRS exception rules use ctl:ruleRemoveById=lo-hi).
+        ctl_ranges: list[tuple[int, int]] = []
+        ctl_tags: list[str] = []
+        all_actions = list(rule.actions) + [
+            a for sub in rule.chain for a in sub.actions
+        ]
+        for a in all_actions:
+            if a.name != "ctl" or not a.argument:
+                continue
+            key, _, val = a.argument.partition("=")
+            key = key.strip().lower()
+            val = val.strip()
+            if key == "ruleremovebyid":
+                if "-" in val and not val.startswith("-"):
+                    lo, _, hi = val.partition("-")
+                    if lo.isdigit() and hi.isdigit():
+                        ctl_ranges.append((int(lo), int(hi)))
+                elif val.isdigit():
+                    ctl_ranges.append((int(val), int(val)))
+            elif key == "ruleremovebytag":
+                ctl_tags.append(val)
+            # other ctl keys (ruleEngine, auditEngine, ...) are per-
+            # transaction engine switches the batch model does not carry;
+            # recorded as approximations.
+            elif key:
+                self.report.approximate(rule.id, f"ctl:{key} ignored")
         self.rules.append(
             CompiledRule(
                 rule_id=rule.id or 0,
@@ -749,6 +785,8 @@ class _Lowering:
                 severity=rule.severity,
                 tags=rule.tags,
                 logs=not any(a.name == "nolog" for a in rule.actions),
+                ctl_remove_ranges=ctl_ranges,
+                ctl_remove_tags=ctl_tags,
             )
         )
         # Record runtime setvar increments for the counter plan.

@@ -14,6 +14,7 @@ Content-Type — reference ``hack/generate_coreruleset_configmaps.py`` rules
 from __future__ import annotations
 
 import json
+import re
 from dataclasses import dataclass, field
 
 from ..compiler.ruleset import (
@@ -108,6 +109,76 @@ def _flatten_json(obj, prefix: str, out: list[tuple[bytes, bytes]]) -> None:
         out.append((prefix.encode("utf-8", "replace"), val))
 
 
+def _parse_multipart(
+    content_type: str, body: bytes
+) -> tuple[list[tuple[bytes, bytes]], list[tuple[str, bytes, int]], int, int]:
+    """Minimal RFC 2046 multipart/form-data parser (reference data plane:
+    Coraza's bodyprocessors/multipart.go). Returns (args, files,
+    strict_error, unmatched_boundary):
+
+    - non-file parts land in ARGS_POST as (name, value);
+    - file parts land in FILES as (field, filename, size) — file BYTES
+      are never made matchable (CRS matches FILES/FILES_NAMES only);
+    - strict_error: malformed framing CRS 922110-shape rules key on
+      (missing/invalid boundary parameter, part without terminating
+      CRLF, content-disposition missing);
+    - unmatched_boundary: body contains what looks like a boundary line
+      that does not match the declared boundary (CRS 922120 shape)."""
+    m = re.search(r'boundary="?([^";,]{1,256})"?', content_type, re.I)
+    if not m:
+        return [], [], 1, 0
+    boundary = m.group(1).encode("latin-1", "replace")
+    delim = b"--" + boundary
+    args: list[tuple[bytes, bytes]] = []
+    files: list[tuple[str, bytes, int]] = []
+    strict = 0
+    unmatched = 0
+
+    segments = body.split(delim)
+    if len(segments) < 2 or not body.rstrip(b"\r\n ").endswith(delim + b"--"):
+        strict = 1
+    for seg in segments[1:]:
+        if seg.startswith(b"--"):
+            break  # closing delimiter
+        if not seg.startswith(b"\r\n") and not seg.startswith(b"\n"):
+            strict = 1
+            continue
+        part = seg.lstrip(b"\r\n")
+        head, sep, content = part.partition(b"\r\n\r\n")
+        if not sep:
+            head, sep, content = part.partition(b"\n\n")
+            if not sep:
+                strict = 1
+                continue
+        content = content[:-2] if content.endswith(b"\r\n") else content.rstrip(b"\n")
+        hm = re.search(
+            rb'content-disposition\s*:\s*form-data\s*;([^\r\n]*)', head, re.I
+        )
+        if not hm:
+            strict = 1
+            continue
+        disp = hm.group(1)
+        nm = re.search(rb'name="([^"]*)"', disp)
+        fm = re.search(rb'filename="([^"]*)"', disp)
+        name = nm.group(1) if nm else b""
+        if not nm:
+            strict = 1
+        if fm is not None:
+            files.append(
+                (name.decode("latin-1", "replace"), fm.group(1), len(content))
+            )
+        else:
+            args.append((name, content))
+    # Boundary-looking lines inside the body that are not the declared
+    # boundary (evasion probe: smuggle a second boundary).
+    for line in body.split(b"\n"):
+        line = line.strip(b"\r")
+        if line.startswith(b"--") and len(line) > 4 and not line.startswith(delim):
+            unmatched = 1
+            break
+    return args, files, strict, unmatched
+
+
 class TargetExtractor:
     """Extracts targets/numerics for one compiled ruleset."""
 
@@ -142,6 +213,9 @@ class TargetExtractor:
 
         args_get = _parse_pairs(req.query_string)
         args_post: list[tuple[bytes, bytes]] = []
+        files: list[tuple[str, bytes, int]] = []  # (field, filename, size)
+        multipart_strict_error = 0
+        multipart_unmatched_boundary = 0
         processor = ""
         if self.body_access and body:
             ctype = (req.header("content-type") or "").lower()
@@ -150,6 +224,16 @@ class TargetExtractor:
                 try:
                     _flatten_json(json.loads(body.decode("utf-8", "replace")), "json", args_post)
                 except (ValueError, RecursionError):
+                    reqbody_error = 1
+            elif "multipart/form-data" in ctype:
+                processor = "MULTIPART"
+                (
+                    args_post,
+                    files,
+                    multipart_strict_error,
+                    multipart_unmatched_boundary,
+                ) = _parse_multipart(req.header("content-type") or "", body)
+                if multipart_strict_error:
                     reqbody_error = 1
             elif "x-www-form-urlencoded" in ctype or not ctype:
                 processor = "URLENCODED"
@@ -170,6 +254,10 @@ class TargetExtractor:
             add("ARGS_POST", kn, v)
             add("ARGS_NAMES", kn, k)
             add("ARGS_POST_NAMES", kn, k)
+
+        for field_name, filename, _size in files:
+            add("FILES", field_name, filename)
+            add("FILES_NAMES", field_name, field_name.encode("latin-1", "replace"))
 
         for hk, hv in req.headers:
             add("REQUEST_HEADERS", hk, hv.encode("latin-1", "replace"))
@@ -232,11 +320,11 @@ class TargetExtractor:
         numeric_values = {
             "REQUEST_BODY_LENGTH": len(body),
             "REQBODY_ERROR": reqbody_error,
-            "MULTIPART_STRICT_ERROR": 0,
-            "MULTIPART_UNMATCHED_BOUNDARY": 0,
+            "MULTIPART_STRICT_ERROR": multipart_strict_error,
+            "MULTIPART_UNMATCHED_BOUNDARY": multipart_unmatched_boundary,
             "ARGS_COMBINED_SIZE": args_combined,
             "FULL_REQUEST_LENGTH": len(full_request),
-            "FILES_COMBINED_SIZE": 0,
+            "FILES_COMBINED_SIZE": sum(size for _, _, size in files),
             "RESPONSE_STATUS": response_status,
             "DURATION": 0,
         }
@@ -267,6 +355,7 @@ class TargetExtractor:
 
     def _eval_hostop(self, key: tuple, targets: list[ExtractedTarget]) -> int:
         from ..compiler.sqli import is_sqli
+        from ..compiler.xss import is_xss
         from ..compiler.transforms_host import apply_pipeline
 
         _, opname, pipeline, include, exclude = key
@@ -278,6 +367,8 @@ class TargetExtractor:
                 continue
             value = apply_pipeline(t.value, list(pipeline))
             if opname == "sqli" and is_sqli(value)[0]:
+                return 1
+            if opname == "xss" and is_xss(value):
                 return 1
         return 0
 

@@ -93,6 +93,10 @@ class WafModel:
     link_count: jnp.ndarray  # [Rr] int32: number of links per rule
     e_numvar: jnp.ndarray  # [NV, Rl] f32 one-hot of lnumvar
     e_counter: jnp.ndarray  # [C, Rl] f32 one-hot of lcounter
+    # ctl:ruleRemoveById/ByTag: removal[i, j] = 1 when a match of rule i
+    # disables later rule j for the request (order constraint baked in
+    # at build). Applied once after the preliminary link pass.
+    removal: jnp.ndarray  # [Rr, Rr] int8
     # rule arrays [Rr]
     link_matrix: jnp.ndarray  # [Rr, MX]
     link_mask: jnp.ndarray  # [Rr, MX]
@@ -119,6 +123,7 @@ class WafModel:
     host_variant_index: tuple = field(default_factory=tuple)  # pid -> variant slot (-1 device)
     engine_on: bool = True
     detection_only: bool = False
+    has_removals: bool = False  # static: skip the removal matmul when empty
 
     def tree_flatten(self):
         leaves = (
@@ -138,6 +143,7 @@ class WafModel:
             self.link_count,
             self.e_numvar,
             self.e_counter,
+            self.removal,
             self.link_matrix,
             self.link_mask,
             self.decision,
@@ -158,6 +164,7 @@ class WafModel:
             self.host_variant_index,
             self.engine_on,
             self.detection_only,
+            self.has_removals,
         )
         return leaves, aux
 
@@ -329,6 +336,24 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         e_numvar[min(lnumvar[i], nv - 1), i] = 1.0
         e_counter[min(lcounter[i], n_counters - 1), i] = 1.0
 
+    # ctl:ruleRemoveById/ByTag removal matrix: a match of rule i disables
+    # every LATER rule j whose id/tag it names (per-transaction rule
+    # removal — reference: Coraza ctl actions; CRS exception idiom).
+    removal = np.zeros((rr, rr), dtype=np.int8)
+    has_removals = False
+    for i, r in enumerate(crs.rules):
+        if not r.ctl_remove_ranges and not r.ctl_remove_tags:
+            continue
+        for j, r2 in enumerate(crs.rules):
+            if j == i or r2.order_key <= r.order_key:
+                continue
+            hit = any(lo <= r2.rule_id <= hi for lo, hi in r.ctl_remove_ranges)
+            if not hit and r.ctl_remove_tags:
+                hit = any(t in r2.tags for t in r.ctl_remove_tags)
+            if hit:
+                removal[i, j] = 1
+                has_removals = True
+
     return WafModel(
         banks=banks,
         segs=segs,
@@ -346,6 +371,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         link_count=jnp.asarray(link_count),
         e_numvar=jnp.asarray(e_numvar),
         e_counter=jnp.asarray(e_counter),
+        removal=jnp.asarray(removal),
         link_matrix=jnp.asarray(link_matrix),
         link_mask=jnp.asarray(link_mask),
         decision=jnp.asarray(decision),
@@ -366,6 +392,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         host_variant_index=tuple(host_variant_index),
         engine_on=crs.engine_mode != "Off",
         detection_only=crs.engine_mode == "DetectionOnly",
+        has_removals=has_removals,
     )
 
 
@@ -647,6 +674,22 @@ def post_match(
 
     prelim = rules_from_links(link_m)
 
+    # ctl:ruleRemoveById/ByTag — one pass: a matched removing rule
+    # disables its targets for this request BEFORE counters accumulate
+    # and before the final verdict (single-iteration semantics: a ctl
+    # rule disabled by another ctl rule still applies its own removals).
+    removed = None
+    if model.has_removals:
+        removed = (
+            jnp.dot(
+                prelim.astype(jnp.bfloat16),
+                model.removal.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0
+        )  # [B, Rr]
+        prelim = prelim & ~removed
+
     # 4c: anomaly-score counters + threshold links. f32 matmul (exact for
     # |weights| < 2^24) — an int32 matmul would not ride the MXU. Precision
     # HIGHEST keeps the operands f32 on TPU: the default precision demotes
@@ -663,6 +706,8 @@ def post_match(
     m_counter = _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :]) ^ model.lneg[None, :]
     link_m = jnp.where(lt == LINK_COUNTER, m_counter, link_m)
     matched = rules_from_links(link_m)
+    if removed is not None:
+        matched = matched & ~removed
 
     # 5: verdict — first matched decision rule in phase order.
     in_scope = (model.decision[None, :] != 0) & (model.phase[None, :] <= max_phase)

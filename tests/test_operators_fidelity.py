@@ -133,3 +133,39 @@ def test_pm_from_file_missing_is_skipped_not_fatal():
     )
     eng = WafEngine(rules)
     assert any("pmFromFile" in reason for _, reason in eng.compiled.report.skipped)
+
+
+def test_detectxss_rule_end_to_end():
+    """@detectXSS via the host-op link: html5-machine verdicts, not the
+    round-2 approximate regex (compiler/xss.py)."""
+    from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+
+    eng = WafEngine(
+        "SecRuleEngine On\n"
+        'SecRule ARGS "@detectXSS" '
+        '"id:10,phase:2,deny,status:403,t:none,t:urlDecodeUni,t:htmlEntityDecode"\n'
+    )
+    cases = [
+        ("/?c=%3Cimg%20src%3Dx%20onerror%3Dalert(1)%3E", True),
+        ("/?c=%22%20onmouseover%3D%22alert(1)", True),   # attr breakout
+        ("/?u=javascript%3Aalert(1)", True),
+        ("/?u=Ja%09vascript%3Aalert(1)", True),          # tab-in-scheme evasion
+        ("/?c=%3Csvg%2Fonload%3Dalert(1)%3E", True),
+        ("/?c=use+the+%3Cb%3Ebold%3C%2Fb%3E+tag", False),
+        ("/?c=a+%3C+b+and+b+%3E+c", False),
+        ("/?u=https%3A%2F%2Fok.example%2Fpage", False),
+    ]
+    for uri, want in cases:
+        v = eng.evaluate_one(HttpRequest(uri=uri))
+        assert v.interrupted == want, (uri, want, v.interrupted)
+
+
+def test_detectxss_not_approximate():
+    """@detectXSS must not land in the compile report as an approximation
+    anymore (VERDICT r2 missing #4)."""
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+
+    crs = compile_rules(
+        'SecRule ARGS "@detectXSS" "id:1,phase:2,deny,status:403"'
+    )
+    assert not any("detectxss" in r.lower() for _, r in crs.report.approximations)
