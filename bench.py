@@ -136,11 +136,14 @@ def _ftw_replay_requests(batch: int, attack_ratio: float = 0.3, seed: int = 1):
     from coraza_kubernetes_operator_tpu.ftw.runner import _stage_request
 
     corpus_dir = _Path(__file__).parent / "ftw" / "tests-crs-lite"
-    attacks = [
-        _stage_request(stage)
-        for test in load_tests(corpus_dir)
-        for stage in test.stages
-    ]
+    all_stages = [stage for test in load_tests(corpus_dir) for stage in test.stages]
+    # Replay caps bodies at 4 KB: the corpus's body-limit probes (912171's
+    # 1 MB body) would otherwise put a 128 KB-wide tier in EVERY chunk and
+    # the sequential DFA fallback scan would dominate the measurement (and
+    # trip the runtime watchdog). The long-body path is covered by the
+    # conformance tier; the cap is reported, not silent.
+    dropped = sum(1 for s in all_stages if len(s.data) > 4096)
+    attacks = [_stage_request(s) for s in all_stages if len(s.data) <= 4096]
     benign = [r for r in synthetic_requests(batch, attack_ratio=0.0, seed=seed)]
     rng = _random.Random(seed)
     out = []
@@ -149,7 +152,7 @@ def _ftw_replay_requests(batch: int, attack_ratio: float = 0.3, seed: int = 1):
             out.append(attacks[i % len(attacks)])
         else:
             out.append(benign[i])
-    return out, len(attacks)
+    return out, {"stages": len(attacks), "oversize_stages_dropped": dropped}
 
 
 def _config_1(iters, n_chunks):
@@ -281,6 +284,88 @@ def _config_4(iters, n_rules_full, n_rules_xl, batch_xl):
     return res
 
 
+def _config_e2e(iters):
+    """End-to-end HTTP serving (VERDICT r2 item 1): ingest→verdict
+    through the sidecar's bulk API. The load generator POSTs bulk JSON
+    over a persistent connection; the sidecar's native fast path parses
+    the JSON, extracts, transforms, runs host ops and packs rows in C++,
+    tiers + dispatches the device step in Python, and streams the
+    verdict array back. Measurement boundary: client-observed HTTP
+    round trip on localhost, generator and server sharing ONE core (the
+    bench host); per-dispatch device-tunnel overhead is included."""
+    import http.client
+
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar.server import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    text, _pad = _crs_lite_padded(int(os.environ.get("BENCH_RULES_FULL", "800")))
+    eng = WafEngine(text)
+    bulk = int(os.environ.get("BENCH_E2E_BULK", "2048"))
+    reqs, corpus_info = _ftw_replay_requests(bulk)
+    payload = json.dumps(
+        {
+            "requests": [
+                {
+                    "method": r.method,
+                    "uri": r.uri,
+                    "version": r.version,
+                    "headers": [[k, v] for k, v in r.headers],
+                    "body": r.body.decode("latin-1"),
+                    "remote_addr": r.remote_addr,
+                }
+                for r in reqs
+            ]
+        }
+    ).encode()
+
+    sc = TpuEngineSidecar(SidecarConfig(port=0), engine=eng)
+    sc.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", sc.port)
+        headers = {"Content-Type": "application/json"}
+
+        def shot():
+            conn.request("POST", "/waf/v1/evaluate", payload, headers)
+            resp = conn.getresponse()
+            out = resp.read()
+            assert resp.status == 200, out[:200]
+            return out
+
+        t0 = time.perf_counter()
+        out = shot()  # compile + warm
+        compile_s = time.perf_counter() - t0
+        n_verdicts = out.count(b'"interrupted"')
+
+        walls = []
+        for _ in range(max(iters, 20)):
+            t0 = time.perf_counter()
+            shot()
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        p50 = walls[len(walls) // 2]
+        p99 = walls[max(0, math.ceil(len(walls) * 0.99) - 1)]
+        best = walls[0]
+        blocked = json.loads(out)["verdicts"]
+        return {
+            "req_per_s": round(bulk / p50, 1),
+            "req_per_s_best": round(bulk / best, 1),
+            "bulk_size": bulk,
+            "p50_bulk_ms": round(p50 * 1e3, 2),
+            "p99_bulk_ms": round(p99 * 1e3, 2),
+            "samples": len(walls),
+            "verdicts_per_reply": n_verdicts,
+            "blocked_in_bulk": sum(1 for v in blocked if v["interrupted"]),
+            "compile_s": round(compile_s, 1),
+            "boundary": "client HTTP round trip, localhost, single shared core",
+            "corpus": corpus_info,
+        }
+    finally:
+        sc.stop()
+
+
 def _config_5(iters, n_tenants=32):
     """Multi-tenant hot-reload under load (BASELINE config #5)."""
     import jax
@@ -373,7 +458,7 @@ def main() -> None:
     n_rules_full = int(os.environ.get("BENCH_RULES_FULL", "800"))
     n_rules_xl = int(os.environ.get("BENCH_RULES_XL", "5000"))
     batch_xl = int(os.environ.get("BENCH_BATCH_XL", "65536"))
-    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5")
+    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,e2e")
     wanted = {s.strip() for s in which.split(",") if s.strip()}
 
     import jax
@@ -385,8 +470,9 @@ def main() -> None:
         "3": lambda: _config_3(iters, n_chunks, n_rules_full),
         "4": lambda: _config_4(max(2, iters // 2), n_rules_full, n_rules_xl, batch_xl),
         "5": lambda: _config_5(iters),
+        "e2e": lambda: _config_e2e(iters),
     }
-    for key in ("1", "2", "3", "4", "5"):
+    for key in ("1", "2", "3", "4", "5", "e2e"):
         if key not in wanted:
             continue
         for attempt in (1, 2):  # one retry: the axon tunnel's remote_compile

@@ -34,11 +34,13 @@ NONWORD = ALL_BYTES & ~WORD
 
 # Decomposition caps: beyond these the DFA tier is the better engine
 # (e.g. @pm word lists compile to one Aho-Corasick DFA, not 500 channels).
-# MAX_BRANCHES at 64 admits CRS-grade alternation products (tag-list x
-# event-list XSS rules expand to ~40 branches); per-branch conv columns
-# are cheap next to the DFA states the same pattern would cost (a single
-# [^>]{0,60} CRS rule determinizes to ~4k states / ~80 s host time).
-MAX_BRANCHES = 64
+# MAX_BRANCHES at 128 admits CRS-grade alternation products (a 10-tag x
+# 10-event XSS rule expands to ~100 branches). Conv columns after the
+# finals dedup are cheap — branches from a shared token vocabulary
+# collapse to one column per distinct (first segment, suffix) — while
+# the SAME pattern on the DFA tier determinizes to ~4-6k states and
+# scans on the serializing gather path (measured ~4x the whole step).
+MAX_BRANCHES = 128
 MAX_SEG_LEN = 24
 MAX_ELEMENTS = 12
 # Bounded class-gaps: spans <= the unroll cap use shift-unrolled ORs;

@@ -224,8 +224,28 @@ class KubeClient:
     def get(self, kind: str, namespace: str, name: str) -> dict:
         return self._request("GET", self._path(kind, namespace, name))
 
-    def list(self, kind: str, namespace: str | None = None) -> dict:
-        return self._request("GET", self._path(kind, namespace))
+    def list(self, kind: str, namespace: str | None = None, limit: int = 500) -> dict:
+        """List with apiserver chunking: requests pages of ``limit`` items
+        and follows ``metadata.continue`` until exhausted (client-go pager
+        semantics — large collections never arrive in one response)."""
+        base = self._path(kind, namespace)
+        merged: dict | None = None
+        cont: str | None = None
+        while True:
+            params = [f"limit={limit}"] if limit else []
+            if cont:
+                params.append(f"continue={quote(cont)}")
+            doc = self._request(
+                "GET", base + ("?" + "&".join(params) if params else "")
+            )
+            if merged is None:
+                merged = doc
+            else:
+                merged.setdefault("items", []).extend(doc.get("items", []))
+                merged["metadata"] = doc.get("metadata", merged.get("metadata"))
+            cont = (doc.get("metadata") or {}).get("continue")
+            if not cont:
+                return merged
 
     def create(self, kind: str, namespace: str, doc: dict) -> dict:
         return self._request(

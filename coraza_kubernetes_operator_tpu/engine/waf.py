@@ -379,3 +379,27 @@ class WafEngine:
         ``SecResponseBodyLimit``."""
         ex = self.extractor.extract(request, response=response)
         return self._evaluate_extractions([ex], max_phase=4)[0]
+
+
+# -- bulk fast path ----------------------------------------------------------
+
+def _engine_evaluate_bulk_json(self, body: bytes):
+    """Evaluate a bulk JSON payload entirely through the native ingest:
+    C++ parses the JSON, extracts targets, applies host transforms and
+    host ops, and packs rows; Python only tiers, dispatches the device
+    step, and decodes verdicts. Returns (verdicts, request_blob) or
+    None when the native path is unavailable or the JSON is malformed
+    (caller falls back to the schema-error-reporting object path)."""
+    if not self._native.available:
+        return None
+    parsed = self._native.tensorize_json(body)
+    if parsed is None:
+        return None
+    tensors, n_req, blob = parsed
+    if n_req == 0:
+        return [], blob
+    tiers, numvals = tier_tensors(tensors)
+    return self._verdicts_from_tiers(tiers, numvals, n_req), blob
+
+
+WafEngine.evaluate_bulk_json = _engine_evaluate_bulk_json

@@ -105,6 +105,15 @@ def load_library():
     lib.cko_sqli.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
     lib.cko_xss.restype = ctypes.c_int
     lib.cko_xss.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.cko_json_to_blob.restype = ctypes.c_void_p
+    lib.cko_json_to_blob.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.cko_blob_data.restype = ctypes.c_void_p
+    lib.cko_blob_data.argtypes = [ctypes.c_void_p]
+    lib.cko_blob_len.restype = ctypes.c_size_t
+    lib.cko_blob_len.argtypes = [ctypes.c_void_p]
+    lib.cko_blob_nreq.restype = ctypes.c_int
+    lib.cko_blob_nreq.argtypes = [ctypes.c_void_p]
+    lib.cko_blob_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -302,16 +311,45 @@ class NativeTensorizer:
     def available(self) -> bool:
         return self._ctx is not None
 
+    def tensorize_json(self, body: bytes):
+        """Bulk-evaluate JSON body → (tensors, n_requests, request_blob).
+        The whole ingest (JSON parse, extraction, transforms, host ops,
+        row packing) runs in C++; Python never materializes per-request
+        objects. Returns None when the JSON doesn't parse (caller falls
+        back to the schema-error-reporting Python path). The returned
+        request blob lets the caller recover (method, uri, version,
+        remote) for audit records without re-parsing the JSON."""
+        assert self._ctx is not None
+        h = self._lib.cko_json_to_blob(body, len(body))
+        if not h:
+            return None
+        try:
+            n_req = self._lib.cko_blob_nreq(h)
+            data_ptr = self._lib.cko_blob_data(h)
+            blob_len = self._lib.cko_blob_len(h)
+            blob = ctypes.string_at(data_ptr, blob_len)
+        finally:
+            self._lib.cko_blob_free(h)
+        if n_req == 0:
+            return (), 0, b""
+        res = self._lib.cko_tensorize(self._ctx, blob, len(blob), n_req)
+        if not res:
+            return None
+        return self._export(res, n_req), n_req, blob
+
     def tensorize(self, requests: list[HttpRequest]):
         assert self._ctx is not None
         blob = serialize_requests(requests)
         res = self._lib.cko_tensorize(self._ctx, blob, len(blob), len(requests))
         if not res:
             raise RuntimeError("native tensorize failed (malformed batch blob)")
+        return self._export(res, len(requests))
+
+    def _export(self, res, n_requests: int):
         try:
             n_rows = self._lib.cko_result_rows(res)
             max_len = self._lib.cko_result_maxlen(res)
-            n_req = _bucket(max(1, len(requests)))
+            n_req = _bucket(max(1, n_requests))
             t = _bucket_rows(max(1, n_rows))
             length = _bucket(max(_MIN_LEN, max_len))
             h = max(1, self._n_host)
@@ -349,3 +387,42 @@ class NativeTensorizer:
         if self._ctx is not None and self._lib is not None:
             self._lib.cko_ctx_free(self._ctx)
             self._ctx = None
+
+
+def blob_request_lines(blob: bytes, wanted: set[int]) -> dict[int, tuple]:
+    """Walk a request blob and recover (method, uri, version, remote) for
+    the requested indexes — audit records for blocked requests on the
+    bulk fast path, without re-parsing the JSON."""
+    out: dict[int, tuple] = {}
+    pos = 0
+    idx = 0
+    n = len(blob)
+
+    def rd() -> bytes:
+        nonlocal pos
+        (l,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        val = blob[pos : pos + l]
+        pos += l
+        return val
+
+    while pos < n and (wanted is None or idx <= max(wanted)):
+        method = rd()
+        uri = rd()
+        version = rd()
+        (nh,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        for _ in range(nh):
+            rd()
+            rd()
+        rd()  # body
+        remote = rd()
+        if wanted is None or idx in wanted:
+            out[idx] = (
+                method.decode("latin-1", "replace"),
+                uri.decode("latin-1", "replace"),
+                version.decode("latin-1", "replace"),
+                remote.decode("latin-1", "replace"),
+            )
+        idx += 1
+    return out

@@ -16,6 +16,7 @@ differential-tested in ``tests/test_transforms.py``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -102,19 +103,38 @@ def _shift_right(x: jnp.ndarray, k: int, fill=0):
     return jnp.concatenate([pad, x[:, : x.shape[1] - k]], axis=1)
 
 
+# Above this buffer width the one-hot matmul's [N, L, L] operand stops
+# being "tiny" and becomes the dominant allocation (a 512 KB response
+# body would ask for a ~1 TB tensor); the sort formulation is O(L log L)
+# memory/compute and takes over.
+_COMPACT_MATMUL_MAX_L = 512
+
+
 def compact(data: jnp.ndarray, keep: jnp.ndarray):
     """Stably move kept bytes to the front of each row; zero-pad the rest.
 
-    Gather-free: kept byte i lands at column ``pos[i] = #kept before i``
-    (exclusive cumsum), realized as a per-row one-hot permutation matmul —
-    the MXU formulation. An earlier argsort+take_along_axis version cost
-    ~50 ms at [16k, 64] (TPU sort lowering); this is ~100x cheaper. bf16
-    is exact for byte values (8 significand bits ⇒ integers ≤ 256).
+    Two formulations, both gather/scatter-free (TPU scatters serialize):
+
+    - Narrow rows (serving buckets, L <= 512): kept byte i lands at
+      column ``pos[i] = #kept before i`` (exclusive cumsum), realized as
+      a per-row one-hot permutation matmul — the MXU formulation; bf16
+      is exact for byte values. An argsort+take_along_axis version cost
+      ~50 ms at [16k, 64] (TPU sort lowering), the matmul ~100x less.
+    - Wide rows (long-body/response buffers): the [N, L, L] one-hot is
+      quadratic in L, so sort (key = destination column, dropped bytes
+      keyed past the end) moves every kept byte home in O(L log L).
 
     Returns (data, new_lengths)."""
     n, length = data.shape
     keep_i = keep.astype(jnp.int32)
     pos = jnp.cumsum(keep_i, axis=1) - keep_i  # destination column
+    new_len = keep.sum(axis=1, dtype=jnp.int32)
+    if length > _COMPACT_MATMUL_MAX_L:
+        key = jnp.where(keep, pos, jnp.int32(length))
+        _, sval = jax.lax.sort_key_val(key, data.astype(jnp.int32), dimension=1)
+        idx = jnp.arange(length, dtype=jnp.int32)[None, :]
+        packed = jnp.where(idx < new_len[:, None], sval, 0).astype(jnp.uint8)
+        return packed, new_len
     idx = jnp.arange(length, dtype=jnp.int32)
     onehot = keep[:, :, None] & (pos[:, :, None] == idx[None, None, :])
     # [N, L, L]: source i → dest j (each dest column receives <= 1 source)
@@ -124,7 +144,6 @@ def compact(data: jnp.ndarray, keep: jnp.ndarray):
         onehot.astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     ).astype(jnp.uint8)
-    new_len = keep.sum(axis=1, dtype=jnp.int32)
     return packed, new_len
 
 

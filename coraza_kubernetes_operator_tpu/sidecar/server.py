@@ -250,6 +250,20 @@ class _Handler(BaseHTTPRequestHandler):
         # caller must not be able to probe arbitrary tenants' rulesets.
         trust = self.sidecar.config.trust_tenant_header
         default_tenant = (self.headers.get(TENANT_HEADER) or None) if trust else None
+
+        # Fast path (the ≥100k req/s serving contract): single-tenant
+        # deployments hand the raw JSON body to the native ingest — C++
+        # parses, extracts, transforms, and packs rows; Python tiers,
+        # dispatches the device step, and streams the verdict array.
+        # Falls through to the object path for tenant routing or when
+        # the native parse rejects the payload (schema errors then get
+        # their descriptive 400 from the Python path).
+        if not trust:
+            fast = self.sidecar.evaluate_bulk_fast(body)
+            if fast is not None:
+                self._reply_json(200, {"verdicts": fast})
+                return
+
         try:
             payload = json.loads(body.decode("utf-8"))
             reqs = [request_from_json(o) for o in payload["requests"]]
@@ -401,6 +415,51 @@ class TpuEngineSidecar:
         return self.batcher.evaluate(
             request, timeout_s=self.config.request_timeout_s, tenant=tenant
         )
+
+    def evaluate_bulk_fast(self, body: bytes) -> list[dict] | None:
+        """Native bulk evaluation for the default tenant. Returns the
+        JSON-ready verdict list, or None when unavailable (no engine,
+        native tier off, malformed payload) — the caller then uses the
+        per-request object path. Accounting: metrics count the batch in
+        two increments; audit logs only interrupted requests (the
+        RelevantOnly posture), with request lines recovered from the
+        native request blob."""
+        engine = self.tenants.engine_for(None)
+        if engine is None or not getattr(engine, "native_enabled", False):
+            return None
+        try:
+            out = engine.evaluate_bulk_json(body)
+        except Exception as err:
+            log.error("bulk fast path failed; falling back", err)
+            return None
+        if out is None:
+            return None
+        verdicts, blob = out
+        n_deny = sum(1 for v in verdicts if v.interrupted)
+        self._m_requests.inc(n_deny, action="deny")
+        self._m_requests.inc(len(verdicts) - n_deny, action="allow")
+        if self.audit is not None and n_deny:
+            from ..native import blob_request_lines
+
+            wanted = {i for i, v in enumerate(verdicts) if v.interrupted}
+            lines = blob_request_lines(blob, wanted)
+            meta = engine.rule_meta
+            for i in sorted(wanted):
+                method, uri, version, remote = lines.get(i, ("?", "?", "?", ""))
+                v = verdicts[i]
+                self.audit.log(
+                    AuditRecord(
+                        request_line=f"{method} {uri} {version}",
+                        client=remote,
+                        status=v.status,
+                        interrupted=True,
+                        matched=[
+                            meta.get(rid, {"id": rid}) for rid in v.matched_ids
+                        ],
+                        tenant=self.tenants.default_tenant or "",
+                    )
+                )
+        return [verdict_to_json(v) for v in verdicts]
 
     def evaluate_many(
         self, requests: list[HttpRequest], tenants: list[str | None] | None = None

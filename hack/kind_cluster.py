@@ -1,111 +1,327 @@
 #!/usr/bin/env python3
 """Local cluster bootstrap for integration/conformance runs.
 
-Role parity with the reference's ``hack/kind_cluster.py`` (kind + Gateway
-API CRDs + Istio via Sail + MetalLB + operator): creates a kind cluster,
-installs the Gateway API CRDs, optionally installs Istio (via istioctl if
-present), and deploys this operator with kustomize. Written for clarity
-over completeness — flags gate each layer so CI can install only what a
-job needs.
+Functional parity with the reference's ``hack/kind_cluster.py`` (behavior
+re-implemented first party; reference hack/kind_cluster.py:15-291):
+
+  kind cluster → Gateway API CRDs → MetalLB (address pool carved from the
+  docker ``kind`` network) → Istio via the Sail operator (helm) + Istio
+  control-plane CR → GatewayClass + sample Gateway → this operator via
+  kustomize (+ rollout restart when already present).
+
+Every phase is individually skippable (``--skip-<phase>``) so CI jobs and
+constrained environments install only what they need; ``--dry-run``
+prints the commands without executing (and is what the unit test drives —
+this image has no docker/kind, so the first network-enabled environment
+should be able to run ``make ftw.environment`` unmodified).
 
 Usage:
-  python hack/kind_cluster.py setup [--name coraza-tpu] [--istio]
+  python hack/kind_cluster.py setup [--name coraza-tpu] [--skip-istio ...]
   python hack/kind_cluster.py delete [--name coraza-tpu]
+
+Env: ISTIO_VERSION (required unless --skip-istio), METALLB_VERSION
+(skips MetalLB when unset, like the reference), METALLB_POOL_SIZE (128).
 """
 
 from __future__ import annotations
 
 import argparse
+import ipaddress
+import json
+import os
 import shutil
 import subprocess
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-
-GATEWAY_API_VERSION = "v1.4.1"
+NAMESPACE = "coraza-tpu-system"
+TEST_NAMESPACE = "integration-tests"
 GATEWAY_API_URL = (
     "https://github.com/kubernetes-sigs/gateway-api/releases/download/"
-    "{v}/standard-install.yaml"
+    "v1.4.1/standard-install.yaml"
 )
+SAIL_REPO = "https://istio-ecosystem.github.io/sail-operator"
+
+DRY_RUN = False
 
 
-def run(*cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+def run(
+    cmd: list[str],
+    check: bool = True,
+    capture: bool = False,
+    input_text: str | None = None,
+) -> subprocess.CompletedProcess:
     print("+", " ".join(cmd), flush=True)
-    return subprocess.run(list(cmd), check=check)
+    if DRY_RUN:
+        return subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+    return subprocess.run(
+        cmd, check=check, capture_output=capture, text=True, input=input_text
+    )
 
 
 def need(binary: str) -> None:
-    if shutil.which(binary) is None:
+    if not DRY_RUN and shutil.which(binary) is None:
         raise SystemExit(f"required binary not found on PATH: {binary}")
 
 
-def cluster_exists(name: str) -> bool:
-    out = subprocess.run(
-        ["kind", "get", "clusters"], capture_output=True, text=True
+def kubectl(ctx: str, *args: str, **kw) -> subprocess.CompletedProcess:
+    return run(["kubectl", "--context", ctx, *args], **kw)
+
+
+def apply_manifest(ctx: str, manifest: str, server_side: bool = False) -> None:
+    args = ["apply"] + (["--server-side"] if server_side else []) + ["-f", "-"]
+    kubectl(ctx, *args, input_text=manifest)
+
+
+# -- phases ------------------------------------------------------------------
+
+
+def istio_version() -> str:
+    v = os.environ.get("ISTIO_VERSION")
+    if not v:
+        if DRY_RUN:
+            return "1.28.2"
+        raise SystemExit(
+            "ISTIO_VERSION is required (e.g. 1.28.2); export it or set a "
+            "Makefile default"
+        )
+    return v
+
+
+def kind_network_range() -> str:
+    """Carve the MetalLB pool out of the docker ``kind`` network: the last
+    METALLB_POOL_SIZE addresses of the network's IPv4 subnet."""
+    pool = int(os.environ.get("METALLB_POOL_SIZE", "128"))
+    if not 1 <= pool <= 255:
+        print(f"WARNING: unusual METALLB_POOL_SIZE {pool}", file=sys.stderr)
+    res = run(["docker", "network", "inspect", "kind"], check=False, capture=True)
+    if res.returncode != 0:
+        raise SystemExit("could not inspect the docker 'kind' network")
+    if DRY_RUN and not res.stdout:
+        return "172.18.255.128-172.18.255.255"
+    config = json.loads(res.stdout)[0].get("IPAM", {}).get("Config", [])
+    subnets = [
+        c["Subnet"]
+        for c in config
+        if ":" not in c.get("Subnet", "")  # v4 only
+    ]
+    if not subnets:
+        raise SystemExit(f"no IPv4 subnet on the kind network: {config}")
+    net = ipaddress.ip_network(subnets[0])
+    hosts = list(net.hosts())
+    return f"{hosts[-pool]}-{hosts[-1]}"
+
+
+def phase_cluster(name: str) -> str:
+    need("kind")
+    res = run(["kind", "get", "clusters"], check=False, capture=True)
+    if name in (res.stdout or "").split():
+        print(f"kind cluster {name} already exists")
+    else:
+        run(["kind", "create", "cluster", "--name", name])
+    return f"kind-{name}"
+
+
+def phase_gateway_api(ctx: str) -> None:
+    kubectl(ctx, "apply", "-f", GATEWAY_API_URL)
+
+
+def phase_metallb(ctx: str) -> bool:
+    version = os.environ.get("METALLB_VERSION")
+    if not version:
+        print(
+            "WARNING: METALLB_VERSION not set, skipping MetalLB deployment",
+            file=sys.stderr,
+        )
+        return False
+    url = (
+        "https://raw.githubusercontent.com/metallb/metallb/"
+        f"v{version}/config/manifests/metallb-native.yaml"
     )
-    return name in out.stdout.split()
+    kubectl(ctx, "apply", "--server-side", "-f", url)
+    kubectl(
+        ctx, "wait", "--for=condition=Available", "deployment/controller",
+        "-n", "metallb-system", "--timeout=300s",
+    )
+    # webhook readiness guards the CR creation race (absent in some versions)
+    kubectl(
+        ctx, "wait", "--for=condition=Ready", "pod", "-l",
+        "component=webhook-server", "-n", "metallb-system",
+        "--timeout=300s", check=False,
+    )
+    iprange = kind_network_range()
+    apply_manifest(
+        ctx,
+        f"""apiVersion: metallb.io/v1beta1
+kind: IPAddressPool
+metadata:
+  namespace: metallb-system
+  name: kube-services
+spec:
+  addresses:
+    - {iprange}
+---
+apiVersion: metallb.io/v1beta1
+kind: L2Advertisement
+metadata:
+  name: kube-services
+  namespace: metallb-system
+spec:
+  ipAddressPools:
+    - kube-services
+""",
+        server_side=True,
+    )
+    return True
+
+
+def phase_istio(ctx: str) -> None:
+    need("helm")
+    version = istio_version()
+    run(["helm", "repo", "add", "sail-operator", SAIL_REPO], check=False)
+    run(["helm", "repo", "update"])
+    kubectl(ctx, "create", "namespace", "sail-operator", check=False)
+    listed = run(
+        ["helm", "list", "--namespace", "sail-operator", "--kube-context", ctx,
+         "-o", "json"],
+        check=False, capture=True,
+    )
+    if "sail-operator" not in (listed.stdout or ""):
+        run([
+            "helm", "install", "sail-operator", "sail-operator/sail-operator",
+            "--version", version, "--namespace", "sail-operator",
+            "--kube-context", ctx,
+        ])
+    else:
+        print("sail operator already installed")
+    kubectl(
+        ctx, "wait", "--for=condition=Available", "deployment/sail-operator",
+        "-n", "sail-operator", "--timeout=300s",
+    )
+    kubectl(ctx, "create", "namespace", NAMESPACE, check=False)
+    apply_manifest(
+        ctx,
+        f"""apiVersion: sailoperator.io/v1
+kind: Istio
+metadata:
+  namespace: {NAMESPACE}
+  name: coraza-tpu
+spec:
+  namespace: {NAMESPACE}
+  version: v{version}
+  values:
+    pilot:
+      env:
+        PILOT_ENABLE_GATEWAY_API: "true"
+        PILOT_ENABLE_GATEWAY_API_STATUS: "true"
+        PILOT_ENABLE_GATEWAY_API_DEPLOYMENT_CONTROLLER: "true"
+        PILOT_GATEWAY_API_DEFAULT_GATEWAYCLASS_NAME: "istio"
+        PILOT_GATEWAY_API_CONTROLLER_NAME: "istio.io/gateway-controller"
+""",
+    )
+    kubectl(
+        ctx, "--namespace", NAMESPACE, "wait", "--for=condition=Ready",
+        "istio/coraza-tpu", "--timeout=300s",
+    )
+
+
+def phase_gateway(ctx: str, loadbalancer: bool) -> None:
+    apply_manifest(
+        ctx,
+        """apiVersion: gateway.networking.k8s.io/v1
+kind: GatewayClass
+metadata:
+  name: istio
+spec:
+  controllerName: istio.io/gateway-controller
+""",
+    )
+    kubectl(ctx, "create", "namespace", TEST_NAMESPACE, check=False)
+    sample = str(REPO / "config" / "samples" / "gateway.yaml")
+    if loadbalancer:
+        kubectl(ctx, "-n", TEST_NAMESPACE, "apply", "-f", sample)
+    else:
+        # no MetalLB → keep the gateway service ClusterIP
+        annotated = run(
+            ["kubectl", "annotate", "-f", sample,
+             "networking.istio.io/service-type=ClusterIP", "--local", "-o", "yaml"],
+            capture=True,
+        )
+        kubectl(
+            ctx, "-n", TEST_NAMESPACE, "apply", "-f", "-",
+            input_text=annotated.stdout or "",
+        )
+    kubectl(
+        ctx, "-n", TEST_NAMESPACE, "wait", "--for=condition=Programmed",
+        "gateway/coraza-gateway", "--timeout=300s",
+    )
+
+
+def phase_operator(ctx: str) -> None:
+    existed = (
+        kubectl(
+            ctx, "--namespace", NAMESPACE, "get", "deployment",
+            "coraza-tpu-controller-manager", check=False, capture=True,
+        ).returncode
+        == 0
+    )
+    kubectl(ctx, "apply", "--server-side", "-k", str(REPO / "config" / "default"))
+    if existed:
+        kubectl(
+            ctx, "--namespace", NAMESPACE, "rollout", "restart",
+            "deployment/coraza-tpu-controller-manager",
+        )
+    kubectl(
+        ctx, "--namespace", NAMESPACE, "wait", "--for=condition=Available",
+        "deployment/coraza-tpu-controller-manager", "--timeout=300s",
+    )
+
+
+# -- commands ----------------------------------------------------------------
 
 
 def cmd_setup(args: argparse.Namespace) -> int:
-    need("kind")
     need("kubectl")
-    if not cluster_exists(args.name):
-        run("kind", "create", "cluster", "--name", args.name)
-    else:
-        print(f"kind cluster {args.name} already exists")
-
-    # Gateway API CRDs (pinned, reference installs v1.4.1).
-    run(
-        "kubectl", "apply", "--server-side", "-f",
-        GATEWAY_API_URL.format(v=args.gateway_api_version),
-    )
-
-    if args.istio:
-        need("istioctl")
-        run(
-            "istioctl", "install", "-y",
-            "--set", "profile=minimal",
-            "--set", "values.pilot.env.PILOT_ENABLE_ALPHA_GATEWAY_API=true",
-        )
-        gatewayclass = (
-            "apiVersion: gateway.networking.k8s.io/v1\n"
-            "kind: GatewayClass\n"
-            "metadata:\n  name: istio\nspec:\n  controllerName: istio.io/gateway-controller\n"
-        )
-        p = subprocess.run(
-            ["kubectl", "apply", "-f", "-"], input=gatewayclass, text=True
-        )
-        if p.returncode:
-            return p.returncode
-
-    # Operator: CRDs + RBAC + manager.
-    run("kubectl", "apply", "--server-side", "-k", str(REPO / "config" / "default"))
-    run(
-        "kubectl", "-n", "coraza-tpu-system", "rollout", "restart",
-        "deployment/coraza-tpu-controller-manager", check=False,
-    )
+    ctx = phase_cluster(args.name)
+    if not args.skip_gateway_api:
+        phase_gateway_api(ctx)
+    has_lb = False
+    if not args.skip_metallb:
+        has_lb = phase_metallb(ctx)
+    if not args.skip_istio:
+        phase_istio(ctx)
+        phase_gateway(ctx, loadbalancer=has_lb)
+    if not args.skip_operator:
+        phase_operator(ctx)
     print("cluster ready")
     return 0
 
 
 def cmd_delete(args: argparse.Namespace) -> int:
     need("kind")
-    if cluster_exists(args.name):
-        run("kind", "delete", "cluster", "--name", args.name)
+    res = run(["kind", "get", "clusters"], check=False, capture=True)
+    if args.name in (res.stdout or "").split():
+        run(["kind", "delete", "cluster", "--name", args.name])
     return 0
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    global DRY_RUN
     ap = argparse.ArgumentParser(description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name, fn in (("setup", cmd_setup), ("delete", cmd_delete)):
         p = sub.add_parser(name)
         p.add_argument("--name", default="coraza-tpu")
-        p.add_argument("--gateway-api-version", default=GATEWAY_API_VERSION)
-        p.add_argument("--istio", action="store_true")
+        p.add_argument("--dry-run", action="store_true")
+        for phase in ("gateway-api", "metallb", "istio", "operator"):
+            p.add_argument(
+                f"--skip-{phase}", action="store_true",
+                dest=f"skip_{phase.replace('-', '_')}",
+            )
         p.set_defaults(fn=fn)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
+    DRY_RUN = args.dry_run
     return args.fn(args)
 
 

@@ -1671,6 +1671,274 @@ int cko_xss(void* h, const uint8_t* s, size_t n) {
 
 void cko_ctx_free(void* h) { delete (Ctx*)h; }
 
+// ---------------------------------------------------------------------------
+// Bulk JSON ingest: {"requests":[{method,uri,version,headers,body,
+// remote_addr}, ...]} -> the binary request blob cko_tensorize consumes.
+// The serving sidecar's hot path hands the raw HTTP body here so Python
+// never materializes per-request objects (sidecar/server.py bulk mode).
+// ---------------------------------------------------------------------------
+
+namespace bulkjson {
+
+struct P {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool lit(const char* s) {
+    size_t l = strlen(s);
+    if ((size_t)(end - p) < l || memcmp(p, s, l) != 0) return false;
+    p += l;
+    return true;
+  }
+  // JSON string -> utf-8 bytes (mirrors python str -> encode('utf-8')).
+  bool str(bytes& out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    p++;
+    out.clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': out.push_back('\n'); break;
+          case 't': out.push_back('\t'); break;
+          case 'r': out.push_back('\r'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case '/': out.push_back('/'); break;
+          case '\\': out.push_back('\\'); break;
+          case '"': out.push_back('"'); break;
+          case 'u': {
+            if (end - p < 4) return false;
+            unsigned cp = 0;
+            for (int k = 0; k < 4; k++) {
+              char h = *p++;
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else return false;
+            }
+            if (cp >= 0xD800 && cp < 0xDC00 && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {  // surrogate pair
+              unsigned lo = 0;
+              const char* q = p + 2;
+              bool okp = true;
+              for (int k = 0; k < 4; k++) {
+                char h = *q++;
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { okp = false; break; }
+              }
+              if (okp && lo >= 0xDC00 && lo < 0xE000) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p = q;
+              }
+            }
+            if (cp < 0x80) out.push_back((char)cp);
+            else if (cp < 0x800) {
+              out.push_back((char)(0xC0 | (cp >> 6)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out.push_back((char)(0xE0 | (cp >> 12)));
+              out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            } else {
+              out.push_back((char)(0xF0 | (cp >> 18)));
+              out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+              out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+  // Skip any JSON value (for unknown fields).
+  bool skip() {
+    ws();
+    if (p >= end) return false;
+    char c = *p;
+    if (c == '"') { bytes t; return str(t); }
+    if (c == '{' || c == '[') {
+      char open = c, close = c == '{' ? '}' : ']';
+      int depth = 0;
+      bool instr = false;
+      while (p < end) {
+        char d = *p;
+        if (instr) {
+          if (d == '\\') { p += 2; continue; }
+          if (d == '"') instr = false;
+        } else {
+          if (d == '"') instr = true;
+          else if (d == open) depth++;
+          else if (d == close) {
+            depth--;
+            if (depth == 0) { p++; return true; }
+          }
+        }
+        p++;
+      }
+      return false;
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']') p++;
+    return true;
+  }
+};
+
+struct Blob {
+  bytes data;
+  int n_req = 0;
+
+  void str(const bytes& s) {
+    uint32_t l = (uint32_t)s.size();
+    data.append((const char*)&l, 4);
+    data += s;
+  }
+  void u32(uint32_t v) { data.append((const char*)&v, 4); }
+};
+
+}  // namespace bulkjson
+
+extern "C" {
+
+// Parse a bulk-evaluate JSON body into a request blob. Returns a handle
+// or nullptr on malformed input; read with cko_blob_{data,len,nreq}.
+void* cko_json_to_blob(const uint8_t* json, size_t len) {
+  using namespace bulkjson;
+  P j{(const char*)json, (const char*)json + len};
+  auto out = std::make_unique<Blob>();
+  j.ws();
+  if (!j.lit("{")) return nullptr;
+  bool found = false;
+  while (j.p < j.end) {
+    j.ws();
+    if (j.lit("}")) break;
+    bytes key;
+    if (!j.str(key)) return nullptr;
+    j.ws();
+    if (!j.lit(":")) return nullptr;
+    if (key != "requests") {
+      if (!j.skip()) return nullptr;
+    } else {
+      found = true;
+      j.ws();
+      if (!j.lit("[")) return nullptr;
+      j.ws();
+      if (!j.lit("]")) {
+        while (true) {
+          j.ws();
+          if (!j.lit("{")) return nullptr;
+          bytes method = "GET", uri = "/", version = "HTTP/1.1", body, remote;
+          std::vector<std::pair<bytes, bytes>> headers;
+          while (true) {
+            j.ws();
+            if (j.lit("}")) break;
+            bytes k;
+            if (!j.str(k)) return nullptr;
+            j.ws();
+            if (!j.lit(":")) return nullptr;
+            j.ws();
+            if (k == "method") { if (!j.str(method)) return nullptr; }
+            else if (k == "uri") { if (!j.str(uri)) return nullptr; }
+            else if (k == "version") { if (!j.str(version)) return nullptr; }
+            else if (k == "body") { if (!j.str(body)) return nullptr; }
+            else if (k == "remote_addr") { if (!j.str(remote)) return nullptr; }
+            else if (k == "headers") {
+              if (j.lit("[")) {  // [[k, v], ...]
+                j.ws();
+                if (!j.lit("]")) {
+                  while (true) {
+                    j.ws();
+                    if (!j.lit("[")) return nullptr;
+                    bytes hk, hv;
+                    if (!j.str(hk)) return nullptr;
+                    j.ws();
+                    if (!j.lit(",")) return nullptr;
+                    if (!j.str(hv)) return nullptr;
+                    j.ws();
+                    if (!j.lit("]")) return nullptr;
+                    headers.emplace_back(hk, hv);
+                    j.ws();
+                    if (j.lit(",")) continue;
+                    if (j.lit("]")) break;
+                    return nullptr;
+                  }
+                }
+              } else if (j.lit("{")) {  // {k: v, ...}
+                j.ws();
+                if (!j.lit("}")) {
+                  while (true) {
+                    bytes hk, hv;
+                    if (!j.str(hk)) return nullptr;
+                    j.ws();
+                    if (!j.lit(":")) return nullptr;
+                    if (!j.str(hv)) return nullptr;
+                    headers.emplace_back(hk, hv);
+                    j.ws();
+                    if (j.lit(",")) continue;
+                    if (j.lit("}")) break;
+                    return nullptr;
+                  }
+                }
+              } else {
+                return nullptr;
+              }
+            } else {
+              if (!j.skip()) return nullptr;  // tenant, unknown fields
+            }
+            j.ws();
+            j.lit(",");  // optional separator
+          }
+          out->str(method);
+          out->str(uri);
+          out->str(version);
+          out->u32((uint32_t)headers.size());
+          for (auto& kv : headers) {
+            out->str(kv.first);
+            out->str(kv.second);
+          }
+          out->str(body);
+          out->str(remote);
+          out->n_req++;
+          j.ws();
+          if (j.lit(",")) continue;
+          if (j.lit("]")) break;
+          return nullptr;
+        }
+      }
+    }
+    j.ws();
+    j.lit(",");
+  }
+  if (!found) return nullptr;
+  return out.release();
+}
+
+const uint8_t* cko_blob_data(void* h) {
+  return (const uint8_t*)((bulkjson::Blob*)h)->data.data();
+}
+size_t cko_blob_len(void* h) { return ((bulkjson::Blob*)h)->data.size(); }
+int cko_blob_nreq(void* h) { return ((bulkjson::Blob*)h)->n_req; }
+void cko_blob_free(void* h) { delete (bulkjson::Blob*)h; }
+
+}  // extern "C"
+
 void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
   Ctx* ctx = (Ctx*)h;
   Reader r{blob, blob + len};
