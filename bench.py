@@ -1,6 +1,15 @@
 """Benchmark harness: the five BASELINE.json configs on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+STREAMING CONTRACT (VERDICT r3 item 1: a timeout must not lose
+everything): each config runs in its OWN subprocess under a wall budget
+(``BENCH_CONFIG_BUDGET_S``, default 240s; per-config override
+``BENCH_BUDGET_<KEY>``) and its result is printed to stdout as one JSON
+line ``{"config": key, ...}`` THE MOMENT it completes. The final line is
+the summary ``{"metric", "value", "unit", "vs_baseline", "configs"}`` —
+the driver reads the tail, so partial progress survives a harness
+timeout, and a config that blows its budget is recorded as
+``{"error": "budget"}`` instead of sinking the whole run.
+
 The headline metric is config #3 (full synthetic-CRS-scale ruleset, ~800
 rules) device throughput; the other configs ride along under "configs".
 Baseline = the BASELINE.json north star (1M req/s full-CRS on one v5e-1),
@@ -14,13 +23,21 @@ wall-loop numbers measure the tunnel, not the chip; the single-dispatch
 loop amortizes it exactly the way a real batching sidecar does. p99 is
 reported over per-dispatch wall times divided by chunks-per-dispatch.
 
+Honest-throughput reporting (VERDICT r3 weak #3): every serving result
+carries ``dedup`` = {unique_rows, total_rows, factor} — the value-dedup
+collapse actually observed — and bench traffic carries per-request
+uniqueness (salted query values, UA/Host pools; ``corpus.synthetic_requests``)
+so the factor reflects real traffic repetition, not corpus cycling.
+
 Config #5 exercises the multi-tenant path: N resident compiled tenants,
 windows routed per tenant through the MicroBatcher grouping logic, one
 tenant hot-swapped mid-run (reload off the serving path).
 
-Env overrides: BENCH_CONFIGS (comma list of 1..5), BENCH_ITERS,
+Env overrides: BENCH_CONFIGS (comma list of 1..5,e2e), BENCH_ITERS,
 BENCH_CHUNKS, BENCH_RULES_FULL (default 800), BENCH_RULES_XL (extra @rx
-rules for config #4, default 1000), BENCH_BATCH_XL (default 65536).
+rules for config #4, default 1000), BENCH_BATCH_XL (default 65536),
+BENCH_CONFIG_BUDGET_S / BENCH_BUDGET_<KEY>, BENCH_TOTAL_BUDGET_S,
+BENCH_INPROC=1 (no subprocesses, no budget enforcement).
 """
 
 import json
@@ -32,6 +49,26 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent))
+
+
+def _dedup_stats(tiers, n_req: int) -> dict:
+    """Observed value-dedup collapse: unique matcher rows vs total
+    (target, kinds) rows across tiers. tier tuple layout:
+    (data, lengths, k1, k2, k3, req_id, vdata, vlengths, uid)."""
+    total = 0
+    unique = 0
+    for t in tiers:
+        rid, uid = t[5], t[8]
+        real = rid < n_req
+        n_real = int(real.sum())
+        total += n_real
+        if n_real:
+            unique += int(uid[real].max()) + 1
+    return {
+        "unique_rows": unique,
+        "total_rows": total,
+        "factor": round(total / unique, 2) if unique else None,
+    }
 
 
 def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=None):
@@ -103,6 +140,7 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
         "p99_chunk_ms": round(p99 * 1e3, 3),
         "batch_per_chunk": batch,
         "tier_shapes": [list(t[0].shape) for t in tiers],
+        "dedup": _dedup_stats(tiers, numvals.shape[0]),
         "chunks_per_dispatch": n_chunks,
         "compile_s": round(compile_s, 1),
         "tensorize_s": round(tensorize_s, 3),
@@ -147,9 +185,27 @@ def _ftw_replay_requests(batch: int, attack_ratio: float = 0.3, seed: int = 1):
     benign = [r for r in synthetic_requests(batch, attack_ratio=0.0, seed=seed)]
     rng = _random.Random(seed)
     out = []
+    from coraza_kubernetes_operator_tpu.engine.request import HttpRequest
+
     for i in range(batch):
         if rng.random() < attack_ratio:
-            out.append(attacks[i % len(attacks)])
+            a = attacks[i % len(attacks)]
+            # Per-request uniqueness (VERDICT r3 item 5): real attack
+            # streams vary per request; a corpus stage replayed verbatim
+            # dedups to one matcher row and inflates req/s. The salt adds
+            # a unique benign query arg, leaving the attack payload (and
+            # the rules it trips) untouched.
+            sep = "&" if "?" in a.uri else "?"
+            out.append(
+                HttpRequest(
+                    method=a.method,
+                    uri=f"{a.uri}{sep}_bs={i:x}{rng.randrange(1 << 20):x}",
+                    version=a.version,
+                    headers=a.headers,
+                    body=a.body,
+                    remote_addr=a.remote_addr,
+                )
+            )
         else:
             out.append(benign[i])
     return out, {"stages": len(attacks), "oversize_stages_dropped": dropped}
@@ -234,10 +290,13 @@ def _config_3(iters, n_chunks, n_rules):
     # per-dispatch means of >= BENCH_LAT_ITERS samples. Host-side
     # tensorize+tier cost is reported separately (tensorize_s covers the
     # whole batch once).
+    # One latency point by default (VERDICT r3 item 1c: every extra point
+    # is another full set of per-tier compiles; scan wider via env when
+    # hunting an operating point, not in the driver run).
     lat_iters = int(os.environ.get("BENCH_LAT_ITERS", "100"))
     lat_points = [
         int(b)
-        for b in os.environ.get("BENCH_LAT_POINTS", "1024,1536,2048").split(",")
+        for b in os.environ.get("BENCH_LAT_POINTS", "2048").split(",")
         if b.strip()
     ]
     best = None
@@ -434,11 +493,13 @@ def _config_5(iters, n_tenants=32):
     }
 
 
-def main() -> None:
-    # Persistent XLA compilation cache (same mechanism as tests/conftest):
-    # the realistic configs compile multi-tier programs worth minutes of
-    # device-compile wall; repeat runs with an unchanged compiler produce
-    # byte-identical HLO and skip it entirely.
+# Headline (3) first so a budget-exhausted run still lands the number
+# the driver grades; 4 last (largest compile).
+_CONFIG_ORDER = ("3", "1", "2", "e2e", "5", "4")
+
+
+def _run_config(key: str) -> dict:
+    """Run ONE config in this process and return its result dict."""
     import jax
 
     cache_dir = os.environ.get(
@@ -458,12 +519,6 @@ def main() -> None:
     n_rules_full = int(os.environ.get("BENCH_RULES_FULL", "800"))
     n_rules_xl = int(os.environ.get("BENCH_RULES_XL", "5000"))
     batch_xl = int(os.environ.get("BENCH_BATCH_XL", "65536"))
-    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,e2e")
-    wanted = {s.strip() for s in which.split(",") if s.strip()}
-
-    import jax
-
-    configs = {}
     runners = {
         "1": lambda: _config_1(iters, n_chunks),
         "2": lambda: _config_2(iters, n_chunks),
@@ -472,16 +527,87 @@ def main() -> None:
         "5": lambda: _config_5(iters),
         "e2e": lambda: _config_e2e(iters),
     }
-    for key in ("1", "2", "3", "4", "5", "e2e"):
-        if key not in wanted:
-            continue
-        for attempt in (1, 2):  # one retry: the axon tunnel's remote_compile
-            try:  # endpoint occasionally drops large compiles mid-stream
-                configs[key] = runners[key]()
-                break
+    res = runners[key]()
+    res["platform"] = jax.devices()[0].platform
+    return res
+
+
+def _budget_for(key: str) -> float:
+    per = os.environ.get(f"BENCH_BUDGET_{key.upper()}")
+    if per:
+        return float(per)
+    base = float(os.environ.get("BENCH_CONFIG_BUDGET_S", "240"))
+    # Config 4 compiles 5.8k rules — grant it headroom by default.
+    return base * 1.5 if key == "4" else base
+
+
+def _emit(line: dict) -> None:
+    print(json.dumps(line), flush=True)
+
+
+def main() -> None:
+    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,e2e")
+    wanted = {s.strip() for s in which.split(",") if s.strip()}
+    keys = [k for k in _CONFIG_ORDER if k in wanted]
+
+    configs: dict[str, dict] = {}
+    if os.environ.get("BENCH_INPROC") == "1":
+        for key in keys:
+            try:
+                configs[key] = _run_config(key)
             except Exception as err:
                 configs[key] = {"error": f"{type(err).__name__}: {err}"}
-                time.sleep(5)
+            _emit({"config": key, **configs[key]})
+    else:
+        import subprocess
+
+        total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1500"))
+        t_start = time.monotonic()
+        for key in keys:
+            elapsed = time.monotonic() - t_start
+            if elapsed > total_budget:
+                configs[key] = {"error": "total budget", "elapsed_s": round(elapsed, 1)}
+                _emit({"config": key, **configs[key]})
+                continue
+            budget = min(_budget_for(key), total_budget - elapsed + 30)
+            t0 = time.monotonic()
+            # One retry on child FAILURE (not on budget timeout): the axon
+            # tunnel's remote_compile endpoint occasionally drops large
+            # compiles mid-stream; the second attempt resumes from the
+            # persistent XLA cache. Budget is shared across attempts.
+            for attempt in (1, 2):
+                attempt_budget = budget - (time.monotonic() - t0)
+                if attempt_budget <= 10:
+                    configs.setdefault(key, {"error": "budget", "budget_s": round(budget, 1)})
+                    break
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, __file__, "--child", key],
+                        capture_output=True,
+                        text=True,
+                        timeout=attempt_budget,
+                        cwd=str(Path(__file__).parent),
+                    )
+                    tail = [
+                        ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")
+                    ]
+                    if tail:
+                        configs[key] = json.loads(tail[-1])
+                    else:
+                        configs[key] = {
+                            "error": f"no output (rc {proc.returncode})",
+                            "stderr_tail": proc.stderr[-400:],
+                        }
+                except subprocess.TimeoutExpired:
+                    configs[key] = {"error": "budget", "budget_s": round(budget, 1)}
+                    break
+                except Exception as err:
+                    configs[key] = {"error": f"{type(err).__name__}: {err}"}
+                if "error" not in configs[key]:
+                    break
+                time.sleep(3)
+            configs[key].setdefault("wall_s", round(time.monotonic() - t0, 1))
+            _emit({"config": key, **configs[key]})
 
     headline = configs.get("3", {}).get("req_per_s")
     if headline is None:  # fall back to any successful config
@@ -491,16 +617,33 @@ def main() -> None:
                 break
     headline = headline or 0.0
 
+    platform = next(
+        (c["platform"] for c in configs.values() if "platform" in c), "unknown"
+    )
     result = {
         "metric": "crs_rule_eval_req_per_s_per_chip",
         "value": headline,
         "unit": "req/s",
         "vs_baseline": round(headline / 1_000_000, 4),
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
         "configs": configs,
     }
     print(json.dumps(result))
+    if os.environ.get("BENCH_STRICT") == "1":
+        # Presubmit gate mode: a crashed config or a zero headline must
+        # turn CI red, not exit 0 with an error buried in the JSON.
+        errors = {k: c["error"] for k, c in configs.items() if "error" in c}
+        if errors or headline <= 0:
+            print(json.dumps({"strict_gate": "FAIL", "errors": errors}))
+            sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        try:
+            _emit(_run_config(sys.argv[2]))
+        except Exception as err:
+            _emit({"error": f"{type(err).__name__}: {err}"})
+            sys.exit(1)
+    else:
+        main()

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import dataclasses
+
 import numpy as np
 
 from ..seclang.ast import (
@@ -276,6 +278,10 @@ class CompiledRuleSet:
 # ---------------------------------------------------------------------------
 
 
+def _copy_variable(v):
+    return dataclasses.replace(v)
+
+
 def _setvar_parse(sv: str) -> tuple[str, str, str] | None:
     """Parse a setvar body into (scope.name, op, value) where op ∈ {=, +=, -=}.
     Returns None for deletes (!tx.x) and non-tx scopes."""
@@ -444,6 +450,53 @@ class _Lowering:
                 parsed = _setvar_parse(sv)
                 if parsed and parsed[0].startswith("tx."):
                     self.runtime_tx.add(parsed[0].removeprefix("tx."))
+
+        # ctl:ruleRemoveTargetById=ID;TARGET pre-pass (Coraza runtime
+        # target exclusion). Lowered STATICALLY as rule variants gated on
+        # a synthetic counter the ctl rule increments: when the ctl rule
+        # matches, the variant with the target excluded is active; when
+        # it does not, the original variant is. No new runtime machinery
+        # — the exclusion rides the existing kind-exclusion matrix and
+        # LINK_COUNTER gating (two-pass counter resolution in post_match
+        # keeps the gated variants' own anomaly weights exact).
+        from ..seclang.parser import _parse_variables
+
+        self.ctl_target_removals: dict[int, list[tuple[str, list]]] = {}
+        self.synthetic_incs: dict[int, list[tuple[str, str, str]]] = {}
+        n_ctlrt = 0
+        for rule in program.rules:
+            for a in rule.actions + [x for sub in rule.chain for x in sub.actions]:
+                if a.name != "ctl" or not a.argument:
+                    continue
+                key, _, val = a.argument.partition("=")
+                if key.strip().lower() != "ruleremovetargetbyid":
+                    continue
+                rid_s, _, target_s = val.strip().partition(";")
+                if not rid_s.strip().isdigit() or not target_s.strip():
+                    self.report.approximate(
+                        rule.id, f"ctl:ruleRemoveTargetById malformed: {val!r}"
+                    )
+                    continue
+                target_id = int(rid_s.strip())
+                try:
+                    variables = _parse_variables(target_s.strip(), rule.line)
+                except Exception as err:
+                    self.report.approximate(
+                        rule.id, f"ctl:ruleRemoveTargetById target parse: {err}"
+                    )
+                    continue
+                variables = [
+                    dataclasses.replace(v, exclude=True) for v in variables
+                ]
+                cname = f"__ctlrt_{n_ctlrt}"
+                n_ctlrt += 1
+                self.ctl_target_removals.setdefault(target_id, []).append(
+                    (cname, variables)
+                )
+                self.synthetic_incs.setdefault(id(rule), []).append(
+                    (f"tx.{cname}", "+=", "1")
+                )
+                self.runtime_tx.add(cname)
 
     # -- groups -------------------------------------------------------------
 
@@ -635,6 +688,37 @@ class _Lowering:
             self.counters.append(name)
         return self.counters.index(name)
 
+    def _counter_link(self, cname: str, cmp_name: str, arg: int) -> int:
+        self.links.append(
+            CompiledLink(
+                LINK_COUNTER,
+                cmp=CMP_CODES[cmp_name],
+                cmp_arg=arg,
+                counter=self._counter_id(cname),
+            )
+        )
+        return len(self.links) - 1
+
+    def _lower_rule_links(
+        self, rule: Rule, defaults: list[Action], extra_excludes: list
+    ) -> list[int] | None:
+        """Re-lower a rule's chain with extra exclusion variables appended
+        to the FIRST link (ctl:ruleRemoveTargetById applies to the rule's
+        own target list, not chained sub-rules)."""
+        link_ids: list[int] = []
+        for li, link in enumerate(rule.all_rules()):
+            pipeline = _effective_pipeline(link, defaults)
+            mod = link
+            if li == 0 and extra_excludes:
+                mod = dataclasses.replace(
+                    link, variables=list(link.variables) + list(extra_excludes)
+                )
+            lid = self._lower_link(mod, pipeline, rule.id)
+            if lid is None:
+                return None
+            link_ids.append(lid)
+        return link_ids
+
     # -- main walk ----------------------------------------------------------
 
     def run(self) -> CompiledRuleSet:
@@ -695,6 +779,17 @@ class _Lowering:
                 self.report.skip(rule.id, "data-dependent skip ignored")
 
             defaults = program.default_actions.get(rule.phase or 2, [])
+
+            # SecRuleUpdateTargetById: extra targets (usually exclusions)
+            # joined to the rule's own variable list at lowering time —
+            # without mutating the parsed AST (a program lowered twice
+            # must not accumulate the update twice).
+            update_vars: list = []
+            if rule.id is not None:
+                for lo, hi, extra_vars in program.update_targets:
+                    if lo <= rule.id <= hi:
+                        update_vars.extend(_copy_variable(v) for v in extra_vars)
+
             link_ids: list[int] = []
             ok = True
             for li, link in enumerate(rule.all_rules()):
@@ -704,6 +799,10 @@ class _Lowering:
                     self.report.skip(rule.id, f"transform(s) {bad} unsupported")
                     ok = False
                     break
+                if li == 0 and update_vars:
+                    link = dataclasses.replace(
+                        link, variables=list(link.variables) + update_vars
+                    )
                 lid = self._lower_link(link, pipeline, rule.id)
                 if lid is None:
                     ok = False
@@ -711,8 +810,50 @@ class _Lowering:
                 link_ids.append(lid)
             if not ok or not link_ids:
                 continue
-            self._emit_rule(rule, link_ids, seq)
+
+            removals = self.ctl_target_removals.get(rule.id) if rule.id else None
+            if not removals:
+                self._emit_rule(rule, link_ids, seq)
+                seq += 1
+                continue
+
+            # ctl:ruleRemoveTargetById variants. A: original targets,
+            # active when NO removing ctl matched. B_k: target k excluded,
+            # active when ctl k is the FIRST matching remover (exact for
+            # a single remover; approximate — first-firing exclusion —
+            # when several removers fire at once, reported below).
+            a_links = link_ids + [
+                self._counter_link(cn, "eq", 0) for cn, _ in removals
+            ]
+            self._emit_rule(rule, a_links, seq)
             seq += 1
+            for k, (cname, excl_vars) in enumerate(removals):
+                # update_vars ride along: variant links re-lower from the
+                # pristine AST, which no longer carries the update.
+                links_k = self._lower_rule_links(
+                    rule, defaults, update_vars + list(excl_vars)
+                )
+                if links_k is None:
+                    # Variant A alone is gated on the counter being 0, so
+                    # a missing B variant removes the WHOLE rule whenever
+                    # the ctl fires — record the over-removal.
+                    self.report.approximate(
+                        rule.id,
+                        "ctl:ruleRemoveTargetById variant failed to lower; "
+                        "rule fully disabled when the ctl rule matches",
+                    )
+                    continue
+                gating = [self._counter_link(cname, "ge", 1)] + [
+                    self._counter_link(cj, "eq", 0) for cj, _ in removals[:k]
+                ]
+                self._emit_rule(rule, links_k + gating, seq)
+                seq += 1
+            if len(removals) > 1:
+                self.report.approximate(
+                    rule.id,
+                    "multiple ctl:ruleRemoveTargetById removers: "
+                    "first-firing exclusion applied",
+                )
 
         return self._finalize()
 
@@ -768,6 +909,8 @@ class _Lowering:
                     ctl_ranges.append((int(val), int(val)))
             elif key == "ruleremovebytag":
                 ctl_tags.append(val)
+            elif key == "ruleremovetargetbyid":
+                pass  # lowered as gated rule variants (see __init__ pre-pass)
             # other ctl keys (ruleEngine, auditEngine, ...) are per-
             # transaction engine switches the batch model does not carry;
             # recorded as approximations.
@@ -796,6 +939,7 @@ class _Lowering:
             if parsed is None or not parsed[0].startswith("tx."):
                 continue
             incs.append(parsed)
+        incs.extend(self.synthetic_incs.get(id(rule), ()))
         self.rule_setvars.append(incs)
 
     def _finalize(self) -> CompiledRuleSet:

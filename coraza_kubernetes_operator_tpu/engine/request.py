@@ -109,6 +109,11 @@ def _flatten_json(obj, prefix: str, out: list[tuple[bytes, bytes]]) -> None:
         out.append((prefix.encode("utf-8", "replace"), val))
 
 
+# A line that could be a multipart delimiter: '--' + RFC 2046 bchars (no
+# spaces; 70-char boundary + up to '--' close suffix = 72).
+_BOUNDARY_CANDIDATE = re.compile(rb"--[0-9A-Za-z'()+_,\-./:=?]{1,72}")
+
+
 def _parse_multipart(
     content_type: str, body: bytes
 ) -> tuple[list[tuple[bytes, bytes]], list[tuple[str, bytes, int]], int, int]:
@@ -170,10 +175,19 @@ def _parse_multipart(
         else:
             args.append((name, content))
     # Boundary-looking lines inside the body that are not the declared
-    # boundary (evasion probe: smuggle a second boundary).
+    # boundary (evasion probe: smuggle a second boundary). Only lines that
+    # could actually BE a delimiter count (ADVICE r3: '--' + RFC 2046
+    # bchars token, no interior spaces, at least one alphanumeric) — a PEM
+    # header ('-----BEGIN CERTIFICATE-----'), a markdown rule, or prose
+    # starting with '--' in a form field must not trip CRS 922120.
     for line in body.split(b"\n"):
         line = line.strip(b"\r")
-        if line.startswith(b"--") and len(line) > 4 and not line.startswith(delim):
+        if (
+            len(line) > 4
+            and _BOUNDARY_CANDIDATE.fullmatch(line)
+            and re.search(rb"[0-9A-Za-z]", line[2:])
+            and not line.startswith(delim)
+        ):
             unmatched = 1
             break
     return args, files, strict, unmatched

@@ -164,6 +164,11 @@ class WafEngine:
         self._rule_ids = np.asarray(
             [r.rule_id for r in self.compiled.rules] or [0], dtype=np.int64
         )
+        # rule_id -> phase (the bulk path's body-limit override must not
+        # displace a phase-1 interruption, which precedes body ingest).
+        self._rule_phase: dict[int, int] = {
+            r.rule_id: r.phase for r in self.compiled.rules
+        }
         # Rule metadata for the audit log (id/msg/severity/tags).
         self.rule_meta: dict[int, dict] = {
             r.rule_id: {
@@ -294,13 +299,53 @@ class WafEngine:
         can never change a verdict, only a tier's padding width."""
         if not requests:
             return []
+        prog = self.compiled.program
+        rejected: dict[int, Verdict] = {}
+        if (
+            prog.request_body_access
+            and prog.request_body_limit_action == "Reject"
+        ):
+            # SecRequestBodyLimitAction Reject (Coraza semantics): the
+            # body-limit interruption happens at body ingest — AFTER
+            # phase 1 already ran on the headers — so a phase-1 deny
+            # wins over the 413. ProcessPartial instead evaluates the
+            # truncated prefix (the [:limit] slice in extract()). All
+            # over-limit requests ride ONE batched phase-1 dispatch (an
+            # all-over-limit batch must not serialize per request).
+            over = [
+                i
+                for i, r in enumerate(requests)
+                if len(r.body) > prog.request_body_limit
+            ]
+            if over:
+                exs = [
+                    self.extractor.extract(requests[i], phase1_only=True)
+                    for i in over
+                ]
+                early = self._evaluate_extractions(exs, max_phase=1)
+                for i, v in zip(over, early):
+                    rejected[i] = (
+                        v
+                        if v.interrupted
+                        else Verdict(interrupted=True, status=413, rule_id=None)
+                    )
+        live = [r for i, r in enumerate(requests) if i not in rejected]
+        if not live:
+            return [rejected[i] for i in range(len(requests))]
         if self._native.available:
-            tensors = self._native.tensorize(requests)
+            tensors = self._native.tensorize(live)
         else:
-            extractions = [self.extractor.extract(r) for r in requests]
+            extractions = [self.extractor.extract(r) for r in live]
             tensors = self._tensorize(extractions)
         tiers, numvals = tier_tensors(tensors)
-        return self._verdicts_from_tiers(tiers, numvals, len(requests))
+        verdicts = self._verdicts_from_tiers(tiers, numvals, len(live))
+        if not rejected:
+            return verdicts
+        out: list[Verdict] = []
+        it = iter(verdicts)
+        for i in range(len(requests)):
+            out.append(rejected[i] if i in rejected else next(it))
+        return out
 
     def _verdicts_from_tiers(
         self, tiers, numvals, n_requests: int, max_phase: int = 2
@@ -321,7 +366,12 @@ class WafEngine:
         head, matched, scores = unpack_compact(
             packed, self.model.n_rules, self.model.n_counters
         )
-        counters = list(enumerate(self.compiled.counters))
+        # Internal synthetic counters (ctl gating) stay out of verdicts.
+        counters = [
+            (c, name)
+            for c, name in enumerate(self.compiled.counters)
+            if not name.startswith("__")
+        ]
         verdicts: list[Verdict] = []
         for i in range(n_requests):
             ridx = int(head[i, 2])
@@ -399,7 +449,23 @@ def _engine_evaluate_bulk_json(self, body: bytes):
     if n_req == 0:
         return [], blob
     tiers, numvals = tier_tensors(tensors)
-    return self._verdicts_from_tiers(tiers, numvals, n_req), blob
+    verdicts = self._verdicts_from_tiers(tiers, numvals, n_req)
+    prog = self.compiled.program
+    if prog.request_body_access and prog.request_body_limit_action == "Reject":
+        # Parity with the object path: SecRequestBodyLimitAction Reject
+        # interrupts over-limit bodies with 413 (the C++ tensorizer
+        # truncates at the limit; the blob keeps full lengths). A
+        # phase-1 interruption wins — the limit fires at body ingest,
+        # after phase 1 already ran (Coraza ordering).
+        from ..native import blob_over_limit
+
+        for i in blob_over_limit(blob, prog.request_body_limit):
+            if i < n_req and not (
+                verdicts[i].interrupted
+                and self._rule_phase.get(verdicts[i].rule_id, 2) <= 1
+            ):
+                verdicts[i] = Verdict(interrupted=True, status=413, rule_id=None)
+    return verdicts, blob
 
 
 WafEngine.evaluate_bulk_json = _engine_evaluate_bulk_json

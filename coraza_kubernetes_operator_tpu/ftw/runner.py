@@ -131,19 +131,22 @@ class FtwRunner:
 
     # -- stage execution ----------------------------------------------------
 
-    def _run_stage_inproc(self, stage: FtwStage) -> tuple[int, list[str]]:
+    def _stage_outcome_from_verdict(
+        self, stage: FtwStage, req, verdict
+    ) -> tuple[int, list[str]]:
+        """Resolve a stage's observed status + synthesized audit lines
+        from an already-computed request-phase verdict (response-phase
+        stages run phases 3/4 here for request survivors)."""
         assert self.engine is not None
-        req = _stage_request(stage)
         if stage.response_status is not None:
             # Response-phase stage (loader extension): the request phases
-            # run first (an interrupted request never reaches upstream);
+            # ran first (an interrupted request never reaches upstream);
             # survivors evaluate phases 3/4 against the injected upstream
             # response. Observed status: request verdict if interrupted,
             # else response verdict if interrupted, else the upstream
             # status passes through.
             from ..engine.request import HttpResponse
 
-            verdict = self.engine.evaluate_one(req)
             if not verdict.interrupted:
                 verdict = self.engine.evaluate_response(
                     req,
@@ -155,7 +158,6 @@ class FtwRunner:
                 )
             passthrough = stage.response_status
         else:
-            verdict = self.engine.evaluate_one(req)
             passthrough = 200
         buf = io.StringIO()
         logger = AuditLogger(stream=buf, relevant_only=False)
@@ -171,6 +173,12 @@ class FtwRunner:
         )
         status = verdict.status if verdict.interrupted else passthrough
         return status, buf.getvalue().splitlines()
+
+    def _run_stage_inproc(self, stage: FtwStage) -> tuple[int, list[str]]:
+        assert self.engine is not None
+        req = _stage_request(stage)
+        verdict = self.engine.evaluate_one(req)
+        return self._stage_outcome_from_verdict(stage, req, verdict)
 
     def _run_stage_http(self, stage: FtwStage) -> tuple[int | None, list[str]]:
         """Returns (status, audit lines); status None = transport failure
@@ -231,6 +239,28 @@ class FtwRunner:
     # -- test execution -----------------------------------------------------
 
     def run(self, tests: list[FtwTest]) -> FtwResult:
+        # In-process mode batch-evaluates every stage's REQUEST phases in
+        # one engine.evaluate() call first: row-level tiering makes the
+        # verdicts identical to per-stage evaluation (a documented
+        # invariant, held by tests), while the whole corpus compiles a
+        # handful of tier shapes instead of one set per stage — the
+        # difference between minutes and hours of cold XLA compile.
+        batch: dict[int, object] = {}
+        if self.engine is not None:
+            reqs = []
+            keys = []
+            for test in tests:
+                if test.title in self.overrides:
+                    continue
+                for i, stage in enumerate(test.stages):
+                    reqs.append(_stage_request(stage))
+                    keys.append((test.title, i))
+            if reqs:
+                verdicts = self.engine.evaluate(reqs)
+                batch = {
+                    key: (req, v) for key, req, v in zip(keys, reqs, verdicts)
+                }
+
         result = FtwResult()
         for test in tests:
             if test.title in self.overrides:
@@ -240,7 +270,10 @@ class FtwRunner:
             ignored_reason = None
             for i, stage in enumerate(test.stages):
                 if self.engine is not None:
-                    status, lines = self._run_stage_inproc(stage)
+                    req, verdict = batch[(test.title, i)]
+                    status, lines = self._stage_outcome_from_verdict(
+                        stage, req, verdict
+                    )
                 else:
                     if stage.response_status is not None:
                         # Response injection needs the in-process engine;

@@ -124,6 +124,14 @@ class WafModel:
     engine_on: bool = True
     detection_only: bool = False
     has_removals: bool = False  # static: skip the removal matmul when empty
+    # Remover rule indexes in evaluation (order_key) order — the ctl
+    # pass walks them sequentially so a ctl rule removed by an earlier
+    # ctl never applies its own removals (Coraza in-order semantics).
+    removal_rows: tuple = ()
+    # Static: some rule has BOTH a counter link and nonzero weights (the
+    # ctl:ruleRemoveTargetById variants) — post_match then runs a second
+    # counter pass so counter-gated rules' own setvars still accumulate.
+    two_pass_counters: bool = False
 
     def tree_flatten(self):
         leaves = (
@@ -165,6 +173,8 @@ class WafModel:
             self.engine_on,
             self.detection_only,
             self.has_removals,
+            self.removal_rows,
+            self.two_pass_counters,
         )
         return leaves, aux
 
@@ -353,6 +363,19 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
             if hit:
                 removal[i, j] = 1
                 has_removals = True
+    removal_rows = tuple(
+        sorted(
+            (i for i in range(rr) if i < len(crs.rules) and removal[i].any()),
+            key=lambda i: crs.rules[i].order_key,
+        )
+    )
+
+    w_np = np.asarray(weights)
+    two_pass_counters = any(
+        any(crs.links[l].link_type == LINK_COUNTER for l in r.link_ids)
+        and w_np[i].any()
+        for i, r in enumerate(crs.rules)
+    )
 
     return WafModel(
         banks=banks,
@@ -393,6 +416,8 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         engine_on=crs.engine_mode != "Off",
         detection_only=crs.engine_mode == "DetectionOnly",
         has_removals=has_removals,
+        removal_rows=removal_rows,
+        two_pass_counters=two_pass_counters,
     )
 
 
@@ -674,20 +699,19 @@ def post_match(
 
     prelim = rules_from_links(link_m)
 
-    # ctl:ruleRemoveById/ByTag — one pass: a matched removing rule
-    # disables its targets for this request BEFORE counters accumulate
-    # and before the final verdict (single-iteration semantics: a ctl
-    # rule disabled by another ctl rule still applies its own removals).
+    # ctl:ruleRemoveById/ByTag — in-order semantics (ADVICE r3): walk the
+    # remover rules in evaluation order; a ctl rule removed by an earlier
+    # ctl never fires, so its own removals never apply (the build-time
+    # matrix already restricts each row to LATER rules). The remover set
+    # is small (CRS exception idiom: a handful of 9xx rules), so the
+    # unrolled [B, Rr] masks cost far less than the matchers.
     removed = None
     if model.has_removals:
-        removed = (
-            jnp.dot(
-                prelim.astype(jnp.bfloat16),
-                model.removal.astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32,
-            )
-            > 0
-        )  # [B, Rr]
+        removed = jnp.zeros_like(prelim)
+        rem = model.removal != 0
+        for c in model.removal_rows:
+            fires = prelim[:, c] & ~removed[:, c]
+            removed = removed | (fires[:, None] & rem[c][None, :])
         prelim = prelim & ~removed
 
     # 4c: anomaly-score counters + threshold links. f32 matmul (exact for
@@ -708,6 +732,30 @@ def post_match(
     matched = rules_from_links(link_m)
     if removed is not None:
         matched = matched & ~removed
+
+    if model.two_pass_counters:
+        # Second counter pass: rules gated on a counter link are absent
+        # from prelim (counter links resolve False there), so their own
+        # setvar weights are missing from `counters`. Add the weights of
+        # rules that matched only via counter links, then re-resolve the
+        # counter links and the match set — exact for the CRS shape
+        # (ctl-variant rules score; 949110-style threshold rules don't).
+        extra = matched & ~prelim
+        counters = counters + jnp.dot(
+            extra.astype(jnp.float32),
+            model.weights.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        cvals = _sel_exact(counters, model.e_counter)
+        m_counter = (
+            _compare(model.lcmp[None, :], cvals, model.lcmparg[None, :])
+            ^ model.lneg[None, :]
+        )
+        link_m = jnp.where(lt == LINK_COUNTER, m_counter, link_m)
+        matched = rules_from_links(link_m)
+        if removed is not None:
+            matched = matched & ~removed
 
     # 5: verdict — first matched decision rule in phase order.
     in_scope = (model.decision[None, :] != 0) & (model.phase[None, :] <= max_phase)

@@ -114,6 +114,17 @@ def load_library():
     lib.cko_blob_nreq.restype = ctypes.c_int
     lib.cko_blob_nreq.argtypes = [ctypes.c_void_p]
     lib.cko_blob_free.argtypes = [ctypes.c_void_p]
+    try:
+        lib.cko_blob_overlimit.restype = ctypes.c_int
+        lib.cko_blob_overlimit.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+        ]
+    except AttributeError:
+        pass  # older .so without the scanner; blob_over_limit walks in Python
     _lib = lib
     return _lib
 
@@ -387,6 +398,46 @@ class NativeTensorizer:
         if self._ctx is not None and self._lib is not None:
             self._lib.cko_ctx_free(self._ctx)
             self._ctx = None
+
+
+def blob_over_limit(blob: bytes, limit: int) -> list[int]:
+    """Request indexes in a bulk blob whose (untruncated) body exceeds
+    ``limit`` — the SecRequestBodyLimitAction Reject set for the fast
+    path. Uses the C scanner when loaded; pure-Python walk otherwise."""
+    lib = load_library()
+    if lib is not None and getattr(lib, "cko_blob_overlimit", None) is not None:
+        cap = 4096
+        out = (ctypes.c_int32 * cap)()
+        n = lib.cko_blob_overlimit(blob, len(blob), limit, out, cap)
+        if n <= cap:
+            return list(out[:n])
+        out = (ctypes.c_int32 * n)()
+        n = lib.cko_blob_overlimit(blob, len(blob), limit, out, n)
+        return list(out[:n])
+    res: list[int] = []
+    pos = 0
+    idx = 0
+    n = len(blob)
+
+    def skip() -> int:
+        nonlocal pos
+        (l,) = struct.unpack_from("<I", blob, pos)
+        pos += 4 + l
+        return l
+
+    while pos < n:
+        skip()  # method
+        skip()  # uri
+        skip()  # version
+        (nh,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        for _ in range(2 * nh):
+            skip()
+        if skip() > limit:  # body
+            res.append(idx)
+        skip()  # remote
+        idx += 1
+    return res
 
 
 def blob_request_lines(blob: bytes, wanted: set[int]) -> dict[int, tuple]:

@@ -371,6 +371,9 @@ class RuleSetProgram:
     config: dict[str, str] = field(default_factory=dict)
     removed_id_ranges: list[tuple[int, int]] = field(default_factory=list)
     removed_tags: list[str] = field(default_factory=list)
+    # SecRuleUpdateTargetById: (id_lo, id_hi, [Variable...]) — targets
+    # (typically exclusions) appended to matching rules before lowering.
+    update_targets: list[tuple[int, int, list]] = field(default_factory=list)
 
     def is_removed(self, rule: "Rule") -> bool:
         rid = rule.id

@@ -54,7 +54,6 @@ _PASSTHROUGH_DIRECTIVES = {
     "secignorerulecompilationerrors",
     "secpcrematchlimit",
     "secpcrematchlimitrecursion",
-    "secrequestbodylimitaction",
     "secrequestbodynofileslimit",
     "secresponsebodylimitaction",
     "secresponsebodymimetype",
@@ -384,6 +383,20 @@ def parse(text: str) -> RuleSetProgram:
             setattr(program, _INT_DIRECTIVES[directive], int(args[0]))
             continue
 
+        if directive == "secrequestbodylimitaction":
+            # Enforced by the engine: Reject interrupts over-limit bodies
+            # with 413 (Coraza semantics); ProcessPartial truncates at the
+            # limit and evaluates the prefix. Value is case-insensitive
+            # like every other Seclang engine keyword.
+            canon = {"reject": "Reject", "processpartial": "ProcessPartial"}
+            if len(args) != 1 or args[0].lower() not in canon:
+                raise SeclangParseError(
+                    "SecRequestBodyLimitAction expects Reject|ProcessPartial",
+                    lineno,
+                )
+            program.request_body_limit_action = canon[args[0].lower()]
+            continue
+
         if directive == "secruleremovebyid":
             for arg in args:
                 arg = arg.strip()
@@ -404,8 +417,41 @@ def parse(text: str) -> RuleSetProgram:
             program.removed_tags.append(args[0].strip("\"'"))
             continue
 
-        if directive in ("secruleupdatetargetbyid", "secruleupdateactionbyid",
-                         "secruleupdatetargetbytag"):
+        if directive == "secruleupdatetargetbyid":
+            # Applied by the compiler: appends targets (usually
+            # exclusions like "!ARGS:pwd") to the named rules' variable
+            # lists before lowering (Coraza/ModSec update-target).
+            if len(args) < 2:
+                raise SeclangParseError(
+                    "SecRuleUpdateTargetById expects <id|id-range> <targets>",
+                    lineno,
+                )
+            spec = args[0].strip()
+            if "-" in spec and not spec.startswith("-"):
+                lo, _, hi = spec.partition("-")
+                if not (lo.isdigit() and hi.isdigit()):
+                    raise SeclangParseError(f"invalid id range {spec!r}", lineno)
+                id_lo, id_hi = int(lo), int(hi)
+            elif spec.isdigit():
+                id_lo = id_hi = int(spec)
+            else:
+                raise SeclangParseError(f"invalid rule id {spec!r}", lineno)
+            variables = _parse_variables(args[1], lineno)
+            # The 3-argument REPLACE form (target, replaced-target) is not
+            # implemented; appending only would silently keep the replaced
+            # target active, so record the whole spec for the compile
+            # report instead of half-applying it.
+            if len(args) > 2:
+                program.config.setdefault("secruleupdatetargetbyid_replace", "")
+                program.config["secruleupdatetargetbyid_replace"] += (
+                    (";" if program.config["secruleupdatetargetbyid_replace"] else "")
+                    + " ".join(args)
+                )
+            else:
+                program.update_targets.append((id_lo, id_hi, variables))
+            continue
+
+        if directive in ("secruleupdateactionbyid", "secruleupdatetargetbytag"):
             # Stored for the compiler; currently recorded but not applied.
             program.config.setdefault(directive, "")
             program.config[directive] += (";" if program.config[directive] else "") + " ".join(args)

@@ -421,9 +421,11 @@ class TpuEngineSidecar:
         JSON-ready verdict list, or None when unavailable (no engine,
         native tier off, malformed payload) — the caller then uses the
         per-request object path. Accounting: metrics count the batch in
-        two increments; audit logs only interrupted requests (the
-        RelevantOnly posture), with request lines recovered from the
-        native request blob."""
+        two increments; the audit posture is IDENTICAL to the object
+        path's ``record_verdict`` (ADVICE r3): ``AuditLogger``'s
+        relevant_only setting decides — RelevantOnly logs interrupted or
+        matched requests, full mode logs every request — with request
+        lines recovered from the native request blob."""
         engine = self.tenants.engine_for(None)
         if engine is None or not getattr(engine, "native_enabled", False):
             return None
@@ -438,27 +440,35 @@ class TpuEngineSidecar:
         n_deny = sum(1 for v in verdicts if v.interrupted)
         self._m_requests.inc(n_deny, action="deny")
         self._m_requests.inc(len(verdicts) - n_deny, action="allow")
-        if self.audit is not None and n_deny:
+        if self.audit is not None:
             from ..native import blob_request_lines
 
-            wanted = {i for i, v in enumerate(verdicts) if v.interrupted}
-            lines = blob_request_lines(blob, wanted)
-            meta = engine.rule_meta
-            for i in sorted(wanted):
-                method, uri, version, remote = lines.get(i, ("?", "?", "?", ""))
-                v = verdicts[i]
-                self.audit.log(
-                    AuditRecord(
-                        request_line=f"{method} {uri} {version}",
-                        client=remote,
-                        status=v.status,
-                        interrupted=True,
-                        matched=[
-                            meta.get(rid, {"id": rid}) for rid in v.matched_ids
-                        ],
-                        tenant=self.tenants.default_tenant or "",
+            if self.audit.relevant_only:
+                wanted = {
+                    i
+                    for i, v in enumerate(verdicts)
+                    if v.interrupted or v.matched_ids
+                }
+            else:
+                wanted = set(range(len(verdicts)))
+            if wanted:
+                lines = blob_request_lines(blob, wanted)
+                meta = engine.rule_meta
+                for i in sorted(wanted):
+                    method, uri, version, remote = lines.get(i, ("?", "?", "?", ""))
+                    v = verdicts[i]
+                    self.audit.log(
+                        AuditRecord(
+                            request_line=f"{method} {uri} {version}",
+                            client=remote,
+                            status=v.status,
+                            interrupted=v.interrupted,
+                            matched=[
+                                meta.get(rid, {"id": rid}) for rid in v.matched_ids
+                            ],
+                            tenant=self.tenants.default_tenant or "",
+                        )
                     )
-                )
         return [verdict_to_json(v) for v in verdicts]
 
     def evaluate_many(

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Run a slice of the crs-lite conformance corpus in THIS process and
+print one JSON summary line.
+
+Why a chunk runner exists: jaxlib 0.9.0's XLA:CPU backend corrupts its
+own process state after many successive compiles — the same response-
+phase executable that compiles+serializes cleanly in a fresh process
+(repro: round 4) segfaults in compile or in ``executable.serialize()``
+once a few hundred compiles have accumulated (the full-suite crash
+signature of rounds 3-4). The conformance tier is the biggest single
+source of fresh compiles, so the pytest test shells the corpus out to
+sequential chunk processes: each child performs only its slice's
+compiles (warm entries come from the shared disk cache), writes new
+entries, and exits before the backend degrades.
+
+Usage: run_ftw_chunk.py START COUNT  (test indexes after title-sort)
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# Same JAX bootstrap as tests/conftest.py (children do not inherit it).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+_cache_dir = os.environ.get(
+    "CKO_FTW_CACHE", str(REPO / "tests" / ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def main() -> None:
+    start = int(sys.argv[1])
+    count = int(sys.argv[2])
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+    from coraza_kubernetes_operator_tpu.ftw.loader import load_tests_report
+    from coraza_kubernetes_operator_tpu.ftw.runner import FtwRunner
+
+    corpus = REPO / "ftw" / "tests-crs-lite"
+    tests, skipped = load_tests_report(corpus)
+    tests.sort(key=lambda t: t.title)
+    chunk = tests[start : start + count]
+
+    crs = compile_rules(load_ruleset_text())
+    runner = FtwRunner(engine=WafEngine(crs))
+    result = runner.run(chunk)
+    print(
+        json.dumps(
+            {
+                "total_tests": len(tests),
+                "skipped_files": len(skipped),
+                "passed": result.passed,
+                "failed": result.failed,
+                "ignored": result.ignored,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

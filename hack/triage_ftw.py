@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Triage harness for the crs-lite conformance corpus.
+
+Compiles the bundled ruleset once, replays every test in-process, and for
+each failing stage prints the full picture needed to decide engine-bug vs
+corpus-authoring vs ledger: matched rule ids, per-counter anomaly scores,
+the stage's expectations, and the verdict. The analog of reading the
+reference's go-ftw output next to its ftw/ftw.yml ledger.
+
+Usage: python hack/triage_ftw.py [test-prefix ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+from coraza_kubernetes_operator_tpu.ftw.loader import load_tests_report
+from coraza_kubernetes_operator_tpu.ftw.runner import FtwRunner, _stage_request
+
+CORPUS = REPO / "ftw" / "tests-crs-lite"
+
+
+def main() -> None:
+    prefixes = tuple(sys.argv[1:])
+    crs = compile_rules(load_ruleset_text())
+    engine = WafEngine(crs)
+    runner = FtwRunner(engine=engine)
+    tests, skipped = load_tests_report(CORPUS)
+    result = runner.run(tests)
+    print(json.dumps(result.summary(), indent=2))
+
+    meta = engine.rule_meta
+    for title, reason in sorted(result.failed.items()):
+        if prefixes and not title.startswith(prefixes):
+            continue
+        test = next(t for t in tests if t.title == title)
+        print(f"\n=== {title}: {reason}")
+        for i, stage in enumerate(test.stages):
+            req = _stage_request(stage)
+            verdict = engine.evaluate_one(req)
+            ids = sorted(meta.get(r, {}).get("id", r) for r in verdict.matched_ids)
+            print(f"  stage {i}: {stage.method} {stage.uri}")
+            if stage.data:
+                print(f"    data: {stage.data[:200]!r}")
+            for k, v in stage.headers:
+                print(f"    hdr: {k}: {v}")
+            print(
+                f"    expect status={stage.status} ids={stage.expect_ids} "
+                f"no_ids={stage.no_expect_ids} log~{stage.log_contains!r}"
+            )
+            print(
+                f"    got status={verdict.status} interrupted={verdict.interrupted} "
+                f"matched={ids}"
+            )
+            nz = {k: v for k, v in verdict.scores.items() if v}
+            print(f"    scores: {nz}")
+
+
+if __name__ == "__main__":
+    main()

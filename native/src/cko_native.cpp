@@ -1481,7 +1481,16 @@ static MultipartOut parse_multipart(const bytes& content_type, const bytes& body
     }
   }
 
-  // boundary-looking lines that are not the declared boundary
+  // boundary-looking lines that are not the declared boundary. Parity
+  // with the Python extractor's tightened heuristic: only '--' + RFC
+  // 2046 bchars (no spaces), with at least one alphanumeric after the
+  // dashes, counts as a delimiter candidate — PEM headers / markdown
+  // rules / '--prose' with spaces never trip it.
+  auto is_bchar = [](unsigned char c) {
+    return std::isalnum(c) || c == '\'' || c == '(' || c == ')' || c == '+' ||
+           c == '_' || c == ',' || c == '-' || c == '.' || c == '/' ||
+           c == ':' || c == '=' || c == '?';
+  };
   size_t lp = 0;
   while (lp <= body.size()) {
     size_t nl = body.find('\n', lp);
@@ -1492,9 +1501,19 @@ static MultipartOut parse_multipart(const bytes& content_type, const bytes& body
     if (ls) line = line.substr(ls);
     bool starts_delim =
         line.size() >= delim.size() && line.compare(0, delim.size(), delim) == 0;
-    if (line.rfind("--", 0) == 0 && line.size() > 4 && !starts_delim) {
-      out.unmatched = 1;
-      break;
+    if (line.rfind("--", 0) == 0 && line.size() > 4 && line.size() <= 2 + 72 &&
+        !starts_delim) {
+      bool all_bchars = true;
+      bool has_alnum = false;
+      for (size_t ci = 2; ci < line.size(); ci++) {
+        unsigned char c = static_cast<unsigned char>(line[ci]);
+        if (!is_bchar(c)) { all_bchars = false; break; }
+        if (std::isalnum(c)) has_alnum = true;
+      }
+      if (all_bchars && has_alnum) {
+        out.unmatched = 1;
+        break;
+      }
     }
     if (nl == bytes::npos) break;
     lp = nl + 1;
@@ -1796,8 +1815,38 @@ struct P {
       }
       return false;
     }
-    while (p < end && *p != ',' && *p != '}' && *p != ']') p++;
-    return true;
+    // Primitive token: must be a valid JSON literal or number — the
+    // Python path (json.loads) rejects bare garbage with a 400, and the
+    // fast path must not be a second, looser grammar (ADVICE r3).
+    const char* s0 = p;
+    while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+           *p != '\t' && *p != '\n' && *p != '\r')
+      p++;
+    size_t n = (size_t)(p - s0);
+    auto is_tok = [&](const char* lit_) {
+      return n == strlen(lit_) && memcmp(s0, lit_, n) == 0;
+    };
+    if (is_tok("true") || is_tok("false") || is_tok("null")) return true;
+    // number: -?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?
+    const char* q = s0;
+    const char* qe = s0 + n;
+    if (q < qe && *q == '-') q++;
+    if (q >= qe) return false;
+    if (*q == '0') q++;
+    else if (*q >= '1' && *q <= '9') { while (q < qe && isdigit((unsigned char)*q)) q++; }
+    else return false;
+    if (q < qe && *q == '.') {
+      q++;
+      if (q >= qe || !isdigit((unsigned char)*q)) return false;
+      while (q < qe && isdigit((unsigned char)*q)) q++;
+    }
+    if (q < qe && (*q == 'e' || *q == 'E')) {
+      q++;
+      if (q < qe && (*q == '+' || *q == '-')) q++;
+      if (q >= qe || !isdigit((unsigned char)*q)) return false;
+      while (q < qe && isdigit((unsigned char)*q)) q++;
+    }
+    return q == qe;
   }
 };
 
@@ -1826,9 +1875,10 @@ void* cko_json_to_blob(const uint8_t* json, size_t len) {
   j.ws();
   if (!j.lit("{")) return nullptr;
   bool found = false;
+  bool closed = false;
   while (j.p < j.end) {
     j.ws();
-    if (j.lit("}")) break;
+    if (j.lit("}")) { closed = true; break; }
     bytes key;
     if (!j.str(key)) return nullptr;
     j.ws();
@@ -1902,8 +1952,16 @@ void* cko_json_to_blob(const uint8_t* json, size_t len) {
             } else {
               if (!j.skip()) return nullptr;  // tenant, unknown fields
             }
+            // Strict member separator (ADVICE r3): comma or closing
+            // brace only; trailing commas rejected like json.loads.
             j.ws();
-            j.lit(",");  // optional separator
+            if (j.lit(",")) {
+              j.ws();
+              if (j.p < j.end && *j.p == '}') return nullptr;
+            } else {
+              j.ws();
+              if (j.p >= j.end || *j.p != '}') return nullptr;
+            }
           }
           out->str(method);
           out->str(uri);
@@ -1924,10 +1982,56 @@ void* cko_json_to_blob(const uint8_t* json, size_t len) {
       }
     }
     j.ws();
-    j.lit(",");
+    if (j.lit(",")) {
+      j.ws();
+      if (j.p < j.end && *j.p == '}') return nullptr;  // trailing comma
+    } else {
+      j.ws();
+      if (j.p >= j.end || *j.p != '}') return nullptr;
+    }
   }
-  if (!found) return nullptr;
+  // Strict close + no trailing garbage: the whole body must be exactly
+  // one JSON object, as the Python path enforces.
+  if (!found || !closed) return nullptr;
+  j.ws();
+  if (j.p != j.end) return nullptr;
   return out.release();
+}
+
+// Scan a request blob for bodies exceeding `limit` bytes
+// (SecRequestBodyLimitAction Reject on the bulk fast path: the Python
+// side replaces these verdicts with a 413 interruption). Writes up to
+// max_out request indexes; returns the number found.
+int cko_blob_overlimit(const uint8_t* blob, size_t len, uint32_t limit,
+                       int32_t* out_idx, int max_out) {
+  size_t pos = 0;
+  int idx = 0;
+  int found = 0;
+  auto rd_len = [&](uint32_t& l) {
+    if (pos + 4 > len) return false;
+    memcpy(&l, blob + pos, 4);
+    pos += 4;
+    if (pos + l > len) return false;
+    pos += l;
+    return true;
+  };
+  while (pos < len) {
+    uint32_t l;
+    for (int i = 0; i < 3; i++)
+      if (!rd_len(l)) return found;  // method, uri, version
+    uint32_t nh;
+    if (pos + 4 > len) return found;
+    memcpy(&nh, blob + pos, 4);
+    pos += 4;
+    for (uint32_t h = 0; h < 2 * nh; h++)
+      if (!rd_len(l)) return found;
+    if (!rd_len(l)) return found;  // body
+    if (l > limit && found < max_out) out_idx[found] = idx;
+    if (l > limit) found++;
+    if (!rd_len(l)) return found;  // remote
+    idx++;
+  }
+  return found;
 }
 
 const uint8_t* cko_blob_data(void* h) {

@@ -12,6 +12,7 @@ works because the CPU backend initializes lazily.
 """
 
 import os
+import sys
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -34,3 +35,47 @@ _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+# Crash-proof cache writes: jaxlib 0.9.0's ``executable.serialize()``
+# SIGSEGVs on certain XLA:CPU executables (reproduced deterministically
+# on the crs-lite response-phase program — /tmp-level repros in round 4),
+# killing the whole pytest run at cache-write time. Writes are wrapped in
+# a fork: the child performs the real serialize+write and any crash dies
+# with the child; a hung child is killed after a deadline. Cache READS
+# (the fast path) are untouched, and good executables still get cached.
+from jax._src import compilation_cache as _cc  # noqa: E402
+
+_orig_put = _cc.put_executable_and_time
+
+
+def _forked_put(*args, **kwargs):
+    pid = os.fork()
+    if pid == 0:
+        code = 1
+        try:
+            _orig_put(*args, **kwargs)
+            code = 0
+        except BaseException:
+            pass
+        finally:
+            os._exit(code)
+    import time as _time
+
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        done, status = os.waitpid(pid, os.WNOHANG)
+        if done:
+            if status != 0:
+                sys.stderr.write(
+                    f"conftest: cache write skipped (child status {status})\n"
+                )
+            return
+        _time.sleep(0.05)
+    import signal as _signal
+
+    os.kill(pid, _signal.SIGKILL)
+    os.waitpid(pid, 0)
+    sys.stderr.write("conftest: cache write child timed out; skipped\n")
+
+
+_cc.put_executable_and_time = _forked_put

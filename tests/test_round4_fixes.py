@@ -1,0 +1,276 @@
+"""Round-4 semantic fixes, unit-pinned.
+
+Covers the engine changes behind the conformance reconciliation and the
+advisor findings: SecRequestBodyLimitAction Reject (413), order-aware
+ctl:ruleRemoveById chains, the tightened multipart boundary-candidate
+heuristic (both host paths), and the strict native bulk-JSON grammar
+(reference parity targets: Coraza body-limit interruption and in-order
+ctl semantics; CRS 922120's MULTIPART_UNMATCHED_BOUNDARY).
+"""
+
+import json
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,auditlog,pass"
+"""
+
+
+def _post(body: bytes, ctype: str = "application/octet-stream", uri: str = "/up"):
+    return HttpRequest(
+        method="POST",
+        uri=uri,
+        headers=[("Host", "t.local"), ("Content-Type", ctype)],
+        body=body,
+    )
+
+
+# -- SecRequestBodyLimitAction ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def limit_engine():
+    return WafEngine(
+        BASE
+        + "SecRequestBodyLimit 4096\n"
+        + "SecRequestBodyLimitAction Reject\n"
+        + 'SecRule REQUEST_BODY "@contains evilword" "id:10,phase:2,deny,status:403,t:none"\n'
+    )
+
+
+def test_body_over_limit_rejected_413(limit_engine):
+    v = limit_engine.evaluate_one(_post(b"z" * 5000))
+    assert v.interrupted and v.status == 413
+    assert v.matched_ids == []
+
+
+def test_body_at_limit_evaluated(limit_engine):
+    v = limit_engine.evaluate_one(_post(b"z" * 4000 + b" evilword"))
+    assert v.interrupted and v.status == 403
+
+
+def test_body_over_limit_mixed_batch(limit_engine):
+    reqs = [
+        _post(b"ok"),
+        _post(b"z" * 5000),
+        _post(b"evilword"),
+    ]
+    vs = limit_engine.evaluate(reqs)
+    assert [(v.interrupted, v.status) for v in vs] == [
+        (False, 200),
+        (True, 413),
+        (True, 403),
+    ]
+
+
+def test_process_partial_truncates_instead():
+    eng = WafEngine(
+        BASE
+        + "SecRequestBodyLimit 64\n"
+        + "SecRequestBodyLimitAction ProcessPartial\n"
+        + 'SecRule REQUEST_BODY "@contains evilword" "id:10,phase:2,deny,status:403,t:none"\n'
+    )
+    # Payload beyond the limit is truncated away: request passes.
+    v = eng.evaluate_one(_post(b"a" * 64 + b"evilword"))
+    assert not v.interrupted
+    # Payload within the prefix still caught.
+    v = eng.evaluate_one(_post(b"evilword" + b"a" * 100))
+    assert v.interrupted and v.status == 403
+
+
+def test_bulk_fast_path_rejects_over_limit(limit_engine):
+    if not limit_engine.native_enabled:
+        pytest.skip("native tier unavailable")
+    payload = json.dumps(
+        {
+            "requests": [
+                {"method": "POST", "uri": "/up", "headers": [["Content-Type", "application/octet-stream"]], "body": "ok"},
+                {"method": "POST", "uri": "/up", "headers": [["Content-Type", "application/octet-stream"]], "body": "z" * 5000},
+                {"method": "POST", "uri": "/up", "headers": [["Content-Type", "application/octet-stream"]], "body": "evilword"},
+            ]
+        }
+    ).encode()
+    out = limit_engine.evaluate_bulk_json(payload)
+    assert out is not None
+    verdicts, _blob = out
+    assert [(v.interrupted, v.status) for v in verdicts] == [
+        (False, 200),
+        (True, 413),
+        (True, 403),
+    ]
+
+
+# -- order-aware ctl removal chains ------------------------------------------
+
+
+CTL_CHAIN = (
+    BASE
+    + 'SecRule ARGS:t1 "@streq yes" "id:9001,phase:2,pass,t:none,nolog,ctl:ruleRemoveById=9002"\n'
+    + 'SecRule ARGS:t2 "@streq yes" "id:9002,phase:2,pass,t:none,nolog,ctl:ruleRemoveById=9003"\n'
+    + 'SecRule ARGS:attack "@contains evil" "id:9003,phase:2,deny,status:403,t:none"\n'
+)
+
+
+@pytest.fixture(scope="module")
+def ctl_engine():
+    return WafEngine(CTL_CHAIN)
+
+
+def _get(uri):
+    return HttpRequest(method="GET", uri=uri, headers=[("Host", "t.local")])
+
+
+def test_ctl_removal_applies(ctl_engine):
+    # 9002 fires alone: 9003 removed, attack passes.
+    v = ctl_engine.evaluate_one(_get("/?t2=yes&attack=evil"))
+    assert not v.interrupted
+    assert 9003 not in v.matched_ids
+
+
+def test_ctl_removal_chain_in_order(ctl_engine):
+    # 9001 removes 9002 BEFORE 9002 applies its own removal, so 9003
+    # stays live and blocks (a removed ctl rule never fires — Coraza
+    # in-order semantics; the round-3 single-pass matrix got this wrong).
+    v = ctl_engine.evaluate_one(_get("/?t1=yes&t2=yes&attack=evil"))
+    assert v.interrupted and v.status == 403
+    assert 9003 in v.matched_ids
+
+
+def test_ctl_untriggered_keeps_rule(ctl_engine):
+    v = ctl_engine.evaluate_one(_get("/?attack=evil"))
+    assert v.interrupted and v.status == 403
+
+
+# -- multipart boundary-candidate heuristic ----------------------------------
+
+
+MP_RULES = (
+    BASE
+    + 'SecRule MULTIPART_UNMATCHED_BOUNDARY "@eq 1" "id:22,phase:2,deny,status:403,t:none"\n'
+)
+
+
+def _mp(body: bytes):
+    return HttpRequest(
+        method="POST",
+        uri="/up",
+        headers=[
+            ("Host", "t.local"),
+            ("Content-Type", "multipart/form-data; boundary=XB"),
+        ],
+        body=body,
+    )
+
+
+@pytest.fixture(scope="module")
+def mp_engine():
+    return WafEngine(MP_RULES)
+
+
+def _part(content: bytes) -> bytes:
+    return (
+        b'--XB\r\nContent-Disposition: form-data; name="a"\r\n\r\n'
+        + content
+        + b"\r\n--XB--\r\n"
+    )
+
+
+def test_pem_block_not_flagged(mp_engine):
+    v = mp_engine.evaluate_one(
+        _mp(_part(b"-----BEGIN CERTIFICATE-----\nMIIB\n-----END CERTIFICATE-----"))
+    )
+    assert not v.interrupted
+
+
+def test_markdown_rule_not_flagged(mp_engine):
+    v = mp_engine.evaluate_one(_mp(_part(b"para one\n-----\npara two")))
+    assert not v.interrupted
+
+
+def test_prose_dashes_with_space_not_flagged(mp_engine):
+    v = mp_engine.evaluate_one(_mp(_part(b"-- see the flag list below")))
+    assert not v.interrupted
+
+
+def test_smuggled_boundary_still_flagged(mp_engine):
+    v = mp_engine.evaluate_one(_mp(_part(b"--SMUGGLED")))
+    assert v.interrupted and v.status == 403
+
+
+def test_boundary_heuristic_native_parity(mp_engine):
+    if not mp_engine.native_enabled:
+        pytest.skip("native tier unavailable")
+    bodies = [
+        _part(b"-----BEGIN CERTIFICATE-----"),
+        _part(b"-----"),
+        _part(b"--verbose"),
+        _part(b"--SMUGGLED"),
+        _part(b"-- spaced out"),
+    ]
+    reqs = [_mp(b) for b in bodies]
+    native = [v.interrupted for v in mp_engine.evaluate(reqs)]
+
+    saved = mp_engine._native
+
+    class _Off:
+        available = False
+
+    mp_engine._native = _Off()
+    try:
+        python = [v.interrupted for v in mp_engine.evaluate(reqs)]
+    finally:
+        mp_engine._native = saved
+    assert native == python, (native, python)
+
+
+# -- strict native bulk JSON --------------------------------------------------
+
+
+STRICT_CASES = [
+    # missing comma between members
+    b'{"requests": [{"method": "GET" "uri": "/"}]}',
+    # garbage primitive value
+    b'{"requests": [{"method": "GET", "uri": "/", "x": nonsense}]}',
+    # trailing garbage after the object
+    b'{"requests": []} trailing',
+    # trailing comma in object
+    b'{"requests": [{"method": "GET",}]}',
+    # unterminated top-level object
+    b'{"requests": []',
+]
+
+
+def test_native_json_strict_rejects(limit_engine):
+    if not limit_engine.native_enabled:
+        pytest.skip("native tier unavailable")
+    for payload in STRICT_CASES:
+        assert limit_engine.evaluate_bulk_json(payload) is None, payload
+
+
+def test_native_json_still_accepts_valid(limit_engine):
+    if not limit_engine.native_enabled:
+        pytest.skip("native tier unavailable")
+    payload = json.dumps(
+        {
+            "requests": [
+                {
+                    "method": "GET",
+                    "uri": "/ok",
+                    "version": "HTTP/1.1",
+                    "headers": [["Host", "t.local"], ["Accept", "*/*"]],
+                    "body": "",
+                    "remote_addr": "10.0.0.1",
+                    "tenant": None,
+                }
+            ]
+        }
+    ).encode()
+    out = limit_engine.evaluate_bulk_json(payload)
+    assert out is not None
+    verdicts, _ = out
+    assert len(verdicts) == 1 and not verdicts[0].interrupted
