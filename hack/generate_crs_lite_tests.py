@@ -733,8 +733,8 @@ CASES = [
     ]),
     (932236, [
         ("unix command with shell context blocked", "GET",
-         "/?c=busybox%20nc;id", {}, None, ("block", [932236])),
-        ("command word without shell context passes", "GET",
+         "/?c=mkfifo%20/tmp/f;id", {}, None, ("block", [932236])),
+        ("command word outside the phrase list passes", "GET",
          "/?q=tcpdump+tutorial", {}, None, ("pass",)),
     ]),
     (932240, [
@@ -914,7 +914,7 @@ CASES = [
     ]),
     (942430, [
         ("quote-digit repetition scores (PL2)", "GET",
-         "/?q=%271%272%273%274%27", {}, None, ("block", [942430])),
+         "/?q=%271%272%273%274%27", {}, None, ("score", [942430])),
     ]),
     (943100, [
         ("cookie-setting session script blocked", "GET",
