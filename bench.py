@@ -301,7 +301,18 @@ def _config_3(iters, n_chunks, n_rules):
     ]
     best = None
     for lat_batch in lat_points:
-        lat = _serve_throughput(eng, lat_batch, lat_iters, 16, requests=reqs[:lat_batch])
+        # A latency point must not sink the whole config's numbers: the
+        # axon tunnel occasionally faults on a fresh shape set (observed:
+        # 'TPU device error — often a kernel fault') — record and move on.
+        try:
+            lat = _serve_throughput(
+                eng, lat_batch, lat_iters, 16, requests=reqs[:lat_batch]
+            )
+        except Exception as err:
+            res.setdefault("latency_scan", []).append(
+                {"batch": lat_batch, "error": f"{type(err).__name__}: {err}"}
+            )
+            continue
         entry = {
             "batch": lat_batch,
             "p50_step_ms": lat["p50_chunk_ms"],
@@ -510,12 +521,14 @@ def _run_config(key: str) -> dict:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-    # 32 chunks/dispatch: the axon tunnel costs ~100ms per dispatch
-    # (measured; a local runtime costs ~100us), so steady-state serving
-    # throughput needs enough chunks to amortize it. p99 per-chunk is
-    # still reported from per-dispatch walls divided by chunk count.
-    n_chunks = int(os.environ.get("BENCH_CHUNKS", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    # 8 chunks/dispatch: enough to amortize the axon tunnel's ~100ms
+    # per-dispatch cost (a local runtime costs ~100us) while keeping the
+    # realistic configs inside the per-config wall budget now that
+    # honest-uniqueness traffic makes each chunk orders of magnitude
+    # more device work than the degenerate round-3 batches. p99
+    # per-chunk is reported from per-dispatch walls / chunk count.
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", "8"))
     n_rules_full = int(os.environ.get("BENCH_RULES_FULL", "800"))
     n_rules_xl = int(os.environ.get("BENCH_RULES_XL", "5000"))
     batch_xl = int(os.environ.get("BENCH_BATCH_XL", "65536"))

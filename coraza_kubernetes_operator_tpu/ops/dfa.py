@@ -126,8 +126,16 @@ def stack_dfas(dfas: list[DFA], min_states: int = 1) -> DFABank:
 
 
 # VMEM budget for the Pallas kernel's resident working set (table + per-step
-# accumulator tiles at block_b=128). Banks above this run the XLA take-scan.
-_PALLAS_VMEM_BUDGET = 11 * 2**20
+# accumulator tiles at block_b=128). v5e cores carry ~128MB VMEM; 40MB
+# leaves generous headroom for the compiler's own temporaries (the prior
+# 11MB pushed mid-size banks — e.g. S=104 x G=84 — onto the HBM-resident
+# XLA take-scan, measured 3-4x slower; raising the budget moved them to
+# the Pallas path for ~20% off the whole matcher pass). Banks whose
+# working set does not fit at block_b=128 fall back to the take-scan —
+# block_b is NOT shrunk below 128: it is the lane (minormost) dimension
+# of the dataT BlockSpec and sub-128 lane tiles are unexercised on
+# Mosaic.
+_PALLAS_VMEM_BUDGET = 40 * 2**20
 _PALLAS_BLOCK_B = 128
 
 
@@ -136,7 +144,8 @@ def _pallas_vmem_bytes(s: int, g: int, itemsize: int, length: int) -> int:
     table = 256 * s * gp * itemsize
     # per-step [block_b, S*Gp] accumulator + one fused select intermediate
     work = _PALLAS_BLOCK_B * s * gp * 4 * 2
-    data_tile = length * _PALLAS_BLOCK_B * 4  # [L, block_b] int32 block
+    # dataT tile is lane-padded to 128 and double-buffered by Pallas
+    data_tile = length * _PALLAS_BLOCK_B * 4 * 2
     return table + work + data_tile
 
 

@@ -25,10 +25,11 @@ Layout: states are S-major / groups G-minor, G padded to a lane multiple
 (128); the accumulator reshape ``[Bt, S*Gp] -> [Bt, S, Gp]`` then keeps the
 lane dimension 128-aligned.
 
-Used for banks with S <= 128 (table fits VMEM); larger-state banks fall
-back to the XLA ``take`` scan (``ops/dfa.py``). CPU tests run the kernel in
-interpreter mode on small shapes; the tiered dispatch is in
-``ops/dfa.py:scan_dfa_bank``.
+Used for any dense-table bank whose working set (table + per-step
+accumulator + dataT tile at block_b=128) fits the VMEM budget in
+``ops/dfa.py:_pallas_vmem_bytes``; banks beyond it fall back to the XLA
+``take`` scan. CPU tests run the kernel in interpreter mode on small
+shapes; the tiered dispatch is in ``ops/dfa.py:scan_dfa_bank``.
 """
 
 from __future__ import annotations
