@@ -126,16 +126,18 @@ def stack_dfas(dfas: list[DFA], min_states: int = 1) -> DFABank:
 
 
 # VMEM budget for the Pallas kernel's resident working set (table + per-step
-# accumulator tiles at block_b=128). v5e cores carry ~128MB VMEM; 40MB
-# leaves generous headroom for the compiler's own temporaries (the prior
-# 11MB pushed mid-size banks — e.g. S=104 x G=84 — onto the HBM-resident
-# XLA take-scan, measured 3-4x slower; raising the budget moved them to
-# the Pallas path for ~20% off the whole matcher pass). Banks whose
-# working set does not fit at block_b=128 fall back to the take-scan —
-# block_b is NOT shrunk below 128: it is the lane (minormost) dimension
-# of the dataT BlockSpec and sub-128 lane tiles are unexercised on
-# Mosaic.
-_PALLAS_VMEM_BUDGET = 40 * 2**20
+# accumulator tiles at block_b=128). Banks above it run the XLA take-scan.
+# KNOWN-GOOD at 11MB: raising it to 40MB (to move the S=104 x G=84 header
+# bank onto the Pallas path, ~20% off the matcher pass in isolated
+# profiling) made the kernel pass standalone differential tests but
+# FAULT the device inside the big-model serve loops on real v5e hardware
+# (config 4 'TPU device error — kernel fault'; config 3's remote compile
+# helper crashed) — the larger resident set plus the serve program's own
+# VMEM demand oversubscribes what the estimate models. Do not raise this
+# again without exercising the full serve loop on hardware. block_b
+# stays 128: it is the lane (minormost) dimension of the dataT BlockSpec
+# and sub-128 lane tiles are unexercised on Mosaic.
+_PALLAS_VMEM_BUDGET = 11 * 2**20
 _PALLAS_BLOCK_B = 128
 
 
