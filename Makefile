@@ -56,8 +56,8 @@ bench:  ## Streaming JSON benchmark: one line per config + final summary.
 # every config once with minimal iters; throughput output is discarded.
 .PHONY: bench.warm
 bench.warm:
-	BENCH_ITERS=1 BENCH_LAT_ITERS=2 BENCH_CONFIG_BUDGET_S=600 \
-	BENCH_TOTAL_BUDGET_S=3000 $(PYTHON) bench.py
+	BENCH_ITERS=1 BENCH_LAT_ITERS=2 BENCH_CONFIG_BUDGET_S=1800 \
+	BENCH_TOTAL_BUDGET_S=7200 $(PYTHON) bench.py
 
 .PHONY: bench.smoke
 bench.smoke:  ## Fast single-config bench (presubmit gate; strict exit).

@@ -81,7 +81,6 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     import jax.numpy as jnp
 
     from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
-    from coraza_kubernetes_operator_tpu.engine.waf import tier_tensors
     from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
 
     m = engine.model
@@ -94,7 +93,7 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     else:
         extractions = [engine.extractor.extract(r) for r in requests]
         tensors = engine._tensorize(extractions)
-    tiers, numvals = tier_tensors(tensors)
+    tiers, numvals, masks = engine.tier(tensors)
     tensorize_s = time.perf_counter() - t_ext0
     dev_tiers = jax.device_put(tiers)
     dev_nv = jax.device_put(numvals)
@@ -110,7 +109,7 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
                 (t[0].at[0, 0].set(i.astype(jnp.uint8)),) + tuple(t[1:])
                 for t in tiers
             )
-            out = eval_waf_tiered.__wrapped__(m, perturbed, numvals)
+            out = eval_waf_tiered.__wrapped__(m, perturbed, numvals, masks=masks)
             return out["interrupted"].sum()
 
         return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
@@ -132,7 +131,9 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     p99 = sorted(per_chunk)[max(0, math.ceil(len(per_chunk) * 0.99) - 1)]
 
     blocked = int(
-        jax.numpy.sum(eval_waf_tiered(m, dev_tiers, dev_nv)["interrupted"])
+        jax.numpy.sum(
+            eval_waf_tiered(m, dev_tiers, dev_nv, masks=masks)["interrupted"]
+        )
     )
     return {
         "req_per_s": round(batch / best, 1),

@@ -1,0 +1,104 @@
+"""Kind-partition opportunity analysis (host-only, no device work).
+
+For the config-3 corpus: per matcher block (seg block / DFA bank), what
+fraction of each tier's unique rows carries at least one kind that can
+reach one of the block's groups? Rows below the fraction could skip the
+block entirely — the headroom for kind-partitioned matching."""
+
+import os
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def main():
+    import bench
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine, tier_tensors
+
+    text, _pad = bench._crs_lite_padded(int(os.environ.get("PROF_RULES", "800")))
+    engine = WafEngine(text)
+    m = engine.model
+    crs = engine.compiled
+    reqs, _ = bench._ftw_replay_requests(int(os.environ.get("PROF_BATCH", "4096")))
+    tensors = engine._tensorize([engine.extractor.extract(r) for r in reqs])
+    tiers, numvals, masks = engine.tier(tensors)
+
+    # group -> set of kinds that can reach it (via any link's include set).
+    n_groups = len(crs.groups)
+    gkinds: list[set] = [set() for _ in range(n_groups)]
+    # build_model remapped groups; recompute remap the same way
+    from coraza_kubernetes_operator_tpu.compiler.segments import plan_segments
+    from coraza_kubernetes_operator_tpu.models.waf_model import _state_bucket
+
+    seg_groups = defaultdict(list)
+    buckets = defaultdict(list)
+    for gid, grp in enumerate(crs.groups):
+        pid = crs.group_pipeline[gid]
+        plan = plan_segments(grp.dfa.ast)
+        if plan is not None:
+            seg_groups[pid].append(gid)
+        else:
+            buckets[(pid, _state_bucket(grp.dfa.n_states))].append(gid)
+
+    for link in crs.links:
+        if link.group >= 0:
+            gkinds[link.group].update(link.include_kinds)
+
+    blocks = []  # (name, set_of_kinds)
+    for pid in sorted(seg_groups):
+        ks = set()
+        for g in seg_groups[pid]:
+            ks |= gkinds[g]
+        blocks.append((f"seg pid={pid} G={len(seg_groups[pid])}", ks))
+    for (pid, b), gids in sorted(buckets.items()):
+        ks = set()
+        for g in gids:
+            ks |= gkinds[g]
+        smax = max(crs.groups[g].dfa.n_states for g in gids)
+        blocks.append((f"bank pid={pid} S<={b}({smax}) G={len(gids)}", ks))
+
+    pass
+
+    for ti, t in enumerate(tiers):
+        d, lg, k1, k2, k3, rid, vd, vl, uid = t
+        n_req = numvals.shape[0]
+        real = rid < n_req
+        # per unique row: union of kinds over its pair rows
+        ukinds = defaultdict(set)
+        for pi in np.flatnonzero(real):
+            u = uid[pi]
+            for k in (k1[pi], k2[pi], k3[pi]):
+                if k:
+                    ukinds[u].add(int(k))
+        n_u = len(ukinds)
+        print(f"tier[{ti}] rows={d.shape[0]} L={d.shape[1]} real_unique={n_u}")
+        for name, ks in blocks:
+            hit = sum(1 for u, kk in ukinds.items() if kk & ks)
+            print(f"  {name}: visible_rows={hit}/{n_u} ({100*hit/max(1,n_u):.0f}%)")
+
+    # kind histogram over unique rows of tier 0
+    d, lg, k1, k2, k3, rid, vd, vl, uid = tiers[0]
+    real = rid < numvals.shape[0]
+    cnt = defaultdict(int)
+    seen = set()
+    for pi in np.flatnonzero(real):
+        u = uid[pi]
+        if u in seen:
+            continue
+        seen.add(u)
+        for k in (k1[pi], k2[pi], k3[pi]):
+            if k:
+                cnt[int(k)] += 1
+    print("tier0 kind histogram (unique rows, first pair only):")
+    inv = {v: k for k, v in crs.vocab.kinds.items()}
+    for k, c in sorted(cnt.items(), key=lambda kv: -kv[1])[:20]:
+        print(f"  kind {k} {inv.get(k, '?')}: {c}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Dump the optimized HLO of the config-3 tiered step and print the
+definitions of named fusions (env HLO_OPS=fusion.25994,fusion.25990,...)
+with their source metadata, so trace op names map back to model code."""
+
+import os
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(Path(__file__).parent.parent / ".jax_bench_cache")
+)
+
+import jax
+
+
+def main():
+    import bench
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine, tier_tensors
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+
+    n_rules = int(os.environ.get("PROF_RULES", "800"))
+    batch = int(os.environ.get("PROF_BATCH", "4096"))
+    text, _pad = bench._crs_lite_padded(n_rules)
+    engine = WafEngine(text)
+    reqs, _ = bench._ftw_replay_requests(batch)
+    if engine._native.available:
+        tensors = engine._native.tensorize(reqs)
+    else:
+        tensors = engine._tensorize([engine.extractor.extract(r) for r in reqs])
+    tiers, numvals, masks = engine.tier(tensors)
+    lowered = eval_waf_tiered.lower(engine.model, jax.device_put(tiers), jax.device_put(numvals))
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    out = Path(os.environ.get("HLO_OUT", "/tmp/cfg3_hlo.txt"))
+    out.write_text(txt)
+    print(f"wrote {out} ({len(txt)/1e6:.1f} MB)")
+
+    ops = os.environ.get("HLO_OPS", "").split(",")
+    lines = txt.splitlines()
+    for op in [o.strip() for o in ops if o.strip()]:
+        print(f"\n=== {op} ===")
+        # The computation a fusion calls: `%fusion.N = ... fusion(...), calls=%computation`
+        pat = re.compile(rf"%?{re.escape(op)}\b.*=")
+        for i, ln in enumerate(lines):
+            if pat.search(ln) and "fusion(" in ln or (pat.search(ln) and "= " in ln and op in ln.split("=")[0]):
+                print(ln.strip()[:600])
+                m = re.search(r"calls=%?([\w.\-]+)", ln)
+                if m:
+                    comp = m.group(1)
+                    # print the computation body (first ~40 lines)
+                    start = None
+                    for j, l2 in enumerate(lines):
+                        if l2.startswith(f"%{comp} ") or l2.startswith(f"{comp} "):
+                            start = j
+                            break
+                    if start is not None:
+                        for l2 in lines[start : start + 50]:
+                            print("   ", l2.strip()[:400])
+                            if l2.strip() == "}":
+                                break
+                break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Config-3-shaped fused-step profile: crs-lite + padding rules, ftw
+replay traffic — the exact bench headline shape — timed as the ONE fused
+eval_waf_tiered dispatch, plus a model-shape dump (tiers, banks, segs)
+so the matcher inventory is visible. Optionally captures an XLA trace
+(PROF_TRACE=/tmp/trace)."""
+
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(Path(__file__).parent.parent / ".jax_bench_cache")
+)
+
+import jax
+import jax.numpy as jnp
+
+N_CHUNKS = int(os.environ.get("PROF_CHUNKS", "4"))
+
+
+def main():
+    sys.path.insert(0, str(Path(__file__).parent.parent))
+    import bench
+
+    n_rules = int(os.environ.get("PROF_RULES", "800"))
+    batch = int(os.environ.get("PROF_BATCH", "4096"))
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine, tier_tensors
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+    from coraza_kubernetes_operator_tpu.ops.segment import conv_n2_cols
+
+    text, pad = bench._crs_lite_padded(n_rules)
+    engine = WafEngine(text)
+    m = engine.model
+    reqs, n_attacks = bench._ftw_replay_requests(batch)
+    if engine._native.available:
+        tensors = engine._native.tensorize(reqs)
+    else:
+        tensors = engine._tensorize([engine.extractor.extract(r) for r in reqs])
+    tiers, numvals, masks = engine.tier(tensors)
+
+    print(
+        f"rules={engine.compiled.n_rules} groups={engine.compiled.n_groups} "
+        f"segs={len(m.segs)} banks={len(m.banks)} long_banks={len(m.long_banks)} "
+        f"pipelines={len(m.pipelines)} host_variants={sum(1 for i in m.host_variant_index if i >= 0)}"
+    )
+    for i, b in enumerate(m.banks):
+        print(f"  bank[{i}] pid={m.bank_pipelines[i]} S={b.n_states} G={b.n_groups} dtype={b.t256.dtype}")
+    for i, s in enumerate(m.segs):
+        print(f"  seg[{i}] pid={m.seg_pipelines[i]} kernel={s.kernel.shape} groups={s.n_groups} n2={conv_n2_cols(s.spec)}")
+    for i, b in enumerate(m.long_banks):
+        print(f"  long[{i}] pid={m.long_bank_pipelines[i]} S={b.n_states} G={b.n_groups}")
+    total_pairs = 0
+    for ti, t in enumerate(tiers):
+        total_pairs += t[5].shape[0]
+        print(f"  tier[{ti}] unique={t[0].shape[0]} L={t[0].shape[1]} pairs={t[5].shape[0]}")
+    print(f"  pair_rows={total_pairs} reqs={numvals.shape[0]}")
+
+    tiers_d = jax.device_put(tiers)
+    nv = jax.device_put(numvals)
+
+    @jax.jit
+    def many(d0, rest, nv):
+        def chunk(i):
+            t0 = (d0.at[0, 0].set(i.astype(d0.dtype)),) + tiers_d[0][1:]
+            out = eval_waf_tiered.__wrapped__(engine.model, (t0,) + rest, nv, max_phase=2, masks=masks)
+            return out["status"].astype(jnp.float32).sum()
+
+        return jax.lax.map(chunk, jnp.arange(N_CHUNKS, dtype=jnp.int32))
+
+    args = (tiers_d[0][0], tuple(tiers_d[1:]), nv)
+    t0 = time.perf_counter()
+    out = many(*args)
+    jax.block_until_ready(out)
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s")
+    ts = []
+    for _ in range(int(os.environ.get("PROF_ITERS", "5"))):
+        t1 = time.perf_counter()
+        jax.block_until_ready(many(*args))
+        ts.append(time.perf_counter() - t1)
+    step = statistics.median(ts) / N_CHUNKS
+    print(f"fused tiered step ({batch} reqs): {step*1e3:.1f} ms => {batch/step:,.0f} req/s")
+
+    trace = os.environ.get("PROF_TRACE")
+    if trace:
+        with jax.profiler.trace(trace):
+            jax.block_until_ready(many(*args))
+        print(f"trace written to {trace}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Measurement-floor check: times a trivial op and the REAL fused
+eval_waf_tiered step under the same lax.map chunk harness, so per-stage
+numbers from profile_tiers.py can be read against the harness floor."""
+
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(Path(__file__).parent.parent / ".jax_bench_cache")
+)
+
+import jax
+import jax.numpy as jnp
+
+N_CHUNKS = int(os.environ.get("PROF_CHUNKS", "8"))
+
+
+def timeit(fn, *args, iters=5, **kw):
+    single = fn(*args, **kw)
+    jax.block_until_ready(single)
+
+    @jax.jit
+    def many(*a):
+        def chunk(i):
+            first = a[0]
+            first = first.at[(0,) * first.ndim].set(i.astype(first.dtype))
+            out = fn(first, *a[1:], **kw)
+            leaves = jax.tree_util.tree_leaves(out)
+            return sum(l.astype(jnp.float32).sum() for l in leaves)
+
+        return jax.lax.map(chunk, jnp.arange(N_CHUNKS, dtype=jnp.int32))
+
+    out = many(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = many(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) / N_CHUNKS
+
+
+def main():
+    from coraza_kubernetes_operator_tpu.corpus import synthetic_crs, synthetic_requests
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine, tier_tensors
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+
+    # Floor: trivial elementwise op on a tier-0-sized tensor.
+    import numpy as np
+
+    x = jnp.asarray(np.random.randint(0, 255, (4096, 32), dtype=np.uint8))
+    t = timeit(lambda d: (d.astype(jnp.float32) * 2).sum(), x)
+    print(f"floor (trivial op): {t*1e3:.3f} ms")
+
+    n_rules = int(os.environ.get("PROF_RULES", "800"))
+    batch = int(os.environ.get("PROF_BATCH", "2048"))
+    engine = WafEngine(synthetic_crs(n_rules))
+    requests = synthetic_requests(batch, attack_ratio=0.1, seed=1)
+    if engine._native.available:
+        tensors = engine._native.tensorize(requests)
+    else:
+        tensors = engine._tensorize([engine.extractor.extract(r) for r in requests])
+    tiers, numvals, masks = engine.tier(tensors)
+    tiers_d = jax.device_put(tiers)
+    nv = jax.device_put(numvals)
+
+    # Direct: full tiered step, perturbing tier-0 data per chunk.
+    def step(d0, tiers_rest, nv):
+        t0 = (d0,) + tiers_d[0][1:]
+        return eval_waf_tiered(engine.model, (t0,) + tiers_rest, nv, max_phase=2, masks=masks)[
+            "status"
+        ]
+
+    t = timeit(step, tiers_d[0][0], tuple(tiers_d[1:]), nv)
+    print(f"full eval_waf_tiered step ({batch} reqs): {t*1e3:.2f} ms")
+    print(f"=> {batch/t:,.0f} req/s (device step only)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Op-level device-time breakdown of the config-3 serving step (crs-lite
++ padding rules, ftw replay traffic, tiered path) via jax.profiler —
+attributes the fused eval_waf_tiered dispatch to individual XLA ops so
+the matcher-cost ranking is ground truth, not estimates."""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(Path(__file__).parent.parent / ".jax_bench_cache")
+)
+
+import jax
+
+
+def main():
+    import bench
+    from benchmarks.trace_capture import op_breakdown
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine, tier_tensors
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+
+    n_rules = int(os.environ.get("PROF_RULES", "800"))
+    batch = int(os.environ.get("PROF_BATCH", "4096"))
+    iters = int(os.environ.get("PROF_ITERS", "3"))
+    out_dir = os.environ.get("PROF_TRACE", "/tmp/cko-trace-cfg3")
+
+    text, _pad = bench._crs_lite_padded(n_rules)
+    engine = WafEngine(text)
+    reqs, _ = bench._ftw_replay_requests(batch)
+    if engine._native.available:
+        tensors = engine._native.tensorize(reqs)
+    else:
+        tensors = engine._tensorize([engine.extractor.extract(r) for r in reqs])
+    tiers, numvals, masks = engine.tier(tensors)
+    tiers_d = jax.device_put(tiers)
+    nv = jax.device_put(numvals)
+
+    out = eval_waf_tiered(engine.model, tiers_d, nv, masks=masks)
+    jax.block_until_ready(out["interrupted"])  # compile outside the trace
+
+    jax.profiler.start_trace(out_dir)
+    for _ in range(iters):
+        out = eval_waf_tiered(engine.model, tiers_d, nv, masks=masks)
+    jax.block_until_ready(out["interrupted"])
+    jax.profiler.stop_trace()
+
+    print(f"trace written to {out_dir}")
+    for ms, depth, name in op_breakdown(out_dir, iters, top=40):
+        print(f"{'  ' * depth}{name}: {ms:.2f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,6 @@ def _bucket_rows(n: int) -> int:
     if n <= 2048:
         return _bucket(n)
     return (n + 1023) // 1024 * 1024
-
-
 # Row-level length-tier bounds (buffer widths). A row lands in the
 # smallest tier its bytes (and host-variant bytes) fit; tiers with fewer
 # than _MIN_TIER_ROWS rows are merged into the next wider tier so a few
@@ -50,17 +48,81 @@ def _bucket_rows(n: int) -> int:
 _TIER_BOUNDS = (32, 64, 128, 512, 2048, 8192, 32768)
 _MIN_TIER_ROWS = 256
 
+# Kind-partitioned matching: rows within a length tier are further split
+# into at most CKO_TIER_PARTS partitions by which matcher blocks their
+# kinds can reach (models/waf_model.py block_kinds), so header-only rows
+# never scan arg-only banks. Partitions below _MIN_PART_ROWS merge into
+# the largest one (scanning more blocks is always sound).
+import os as _os
 
-def tier_tensors(tensors):
-    """Split one wide tensorized batch into row-level length tiers.
+_TIER_PARTS = int(_os.environ.get("CKO_TIER_PARTS", "3"))
+_MIN_PART_ROWS = 256
+
+
+def _mask_cost(mask: int, block_cost) -> float:
+    c = 0.0
+    for i, bc in enumerate(block_cost):
+        if i >= 62:
+            break
+        if (mask >> i) & 1:
+            c += bc
+    return c
+
+
+def _cluster_masks(values_counts, block_cost, max_parts: int):
+    """Greedy cost clustering of block masks into <= max_parts clusters.
+    ``values_counts`` is an iterable of (mask, weight); returns a list of
+    (member_mask_values, union_mask). Used ONCE per engine (kind-class
+    computation) so the set of masks jit ever sees is small and stable —
+    per-batch clustering would mint a fresh static mask tuple (and a
+    fresh executable) for every traffic mix."""
+    clusters = [([int(v)], int(v), int(c)) for v, c in values_counts]
+    if len(clusters) > 16:  # cap the O(n^3) greedy; rare kind combos merge first
+        clusters.sort(key=lambda cl: -cl[2])
+        head, tail = clusters[:15], clusters[15:]
+        members, um, rows = [], 0, 0
+        for mem, m, r in tail:
+            members += mem
+            um |= m
+            rows += r
+        head.append((members, um, rows))
+        clusters = head
+    while len(clusters) > max_parts:
+        best, bi, bj = None, 0, 1
+        for i in range(len(clusters)):
+            for j in range(i + 1, len(clusters)):
+                _mi, ui, ri = clusters[i]
+                _mj, uj, rj = clusters[j]
+                delta = (
+                    (ri + rj) * _mask_cost(ui | uj, block_cost)
+                    - ri * _mask_cost(ui, block_cost)
+                    - rj * _mask_cost(uj, block_cost)
+                )
+                if best is None or delta < best:
+                    best, bi, bj = delta, i, j
+        mi, ui, ri = clusters[bi]
+        mj, uj, rj = clusters.pop(bj)
+        clusters[bi] = (mi + mj, ui | uj, ri + rj)
+    return [(mem, um) for mem, um, _rows in clusters]
+
+
+def tier_tensors(tensors, kind_lut=None):
+    """Split one wide tensorized batch into row-level (length x kind
+    partition) tiers.
 
     The matcher's per-row cost is linear in the tier's buffer width
     (conv positions Q = L + 2), and rows are independent until
     post_match, so a long request's short rows (headers, args) should
-    never pay its body's width. Input is the 9-tuple from
-    ``WafEngine._tensorize`` (or the native tensorizer — both produce
-    identical row layouts); output is ``(tiers, numvals)`` where tiers
-    is a tuple of per-tier 8-tuples for ``eval_waf_tiered``."""
+    never pay the body's width. With ``kind_lut`` (``WafEngine``'s
+    kind -> block bitmask table) rows are additionally partitioned by
+    which matcher blocks their kinds can reach; each partition's static
+    mask lets ``eval_waf_tiered`` skip unreachable matchers entirely.
+
+    Input is the 9-tuple from ``WafEngine._tensorize`` (or the native
+    tensorizer — both produce identical row layouts); output is
+    ``(tiers, numvals, masks)`` where tiers is a tuple of per-tier
+    9-tuples for ``eval_waf_tiered`` and masks the aligned static
+    block-bitmask tuple (entries None when kind_lut is absent)."""
     data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths = tensors
     n_req = numvals.shape[0]
     h = vdata.shape[0]
@@ -82,15 +144,9 @@ def tier_tensors(tensors):
         if sel.size:
             raw.append((b, sel))
     tiers = []
-    i = 0
-    while i < len(raw):
-        b, sel = raw[i]
-        while sel.size < _MIN_TIER_ROWS and i + 1 < len(raw):
-            i += 1
-            b = raw[i][0]
-            sel = np.concatenate([sel, raw[i][1]])
-        length = _bucket(max(_MIN_LEN, b))
+    masks: list[int | None] = []
 
+    def emit(sel: np.ndarray, length: int, mask: int | None):
         # VALUE DEDUP: the matcher's output depends only on (bytes,
         # length, variant bytes) — and real traffic repeats values
         # constantly (Host/User-Agent/Accept, header names, hot paths),
@@ -132,8 +188,46 @@ def tier_tensors(tensors):
         uid = np.zeros(p, dtype=np.int32)  # pad pairs read unique row 0
         uid[: sel.size] = inverse
         tiers.append((d, lg, kk[0], kk[1], kk[2], rid, vd, vl, uid))
+        masks.append(mask)
+
+    i = 0
+    while i < len(raw):
+        b, sel = raw[i]
+        while sel.size < _MIN_TIER_ROWS and i + 1 < len(raw):
+            i += 1
+            b = raw[i][0]
+            sel = np.concatenate([sel, raw[i][1]])
+        length = _bucket(max(_MIN_LEN, b))
+
+        if kind_lut is None or _TIER_PARTS <= 1:
+            emit(sel, length, None)
+            i += 1
+            continue
+        # kind_lut maps kinds to CLASS masks (a small fixed per-engine
+        # set), so pmask takes at most ~2^parts distinct values and the
+        # static masks jit sees are bounded and batch-independent.
+        pmask = kind_lut[k1[sel]] | kind_lut[k2[sel]] | kind_lut[k3[sel]]
+        values = np.unique(pmask)
+        parts = [(sel[pmask == v], int(v)) for v in values.tolist()]
+        parts = [(s, um) for s, um in parts if s.size]
+        # merge sub-minimum partitions into the largest (union mask)
+        parts.sort(key=lambda su: -su[0].size)
+        while len(parts) > 1 and parts[-1][0].size < _MIN_PART_ROWS:
+            s_small, m_small = parts.pop()
+            s_big, m_big = parts[0]
+            parts[0] = (np.concatenate([s_big, s_small]), m_big | m_small)
+        if len(parts) == 1:
+            # Single partition: use the scan-everything trace. A content-
+            # dependent union mask here would buy nothing (every block is
+            # scanned for the one partition anyway at small batches) and
+            # each distinct mask value is a fresh jit trace — the
+            # latency path must not churn executables per request mix.
+            emit(sel, length, None)
+        else:
+            for s, um in parts:
+                emit(s, length, int(um))
         i += 1
-    return tuple(tiers), numvals
+    return tuple(tiers), numvals, tuple(masks)
 
 
 @dataclass
@@ -195,6 +289,36 @@ class WafEngine:
         from ..native import NativeTensorizer
 
         self._native = NativeTensorizer(self.compiled)
+        # Kind -> matcher-block bitmask table (kind-partitioned matching):
+        # bit i of entry k = block i (segs then banks, build_model order)
+        # has a group some rule can reach through kind k. tier_tensors
+        # ORs a row's three kind entries into its partition mask; blocks
+        # past bit 61 saturate to always-scanned (match_tier).
+        n_kinds = self.compiled.vocab.n_kinds
+        raw = np.zeros(n_kinds + 1, dtype=np.int64)
+        for bi, ks in enumerate(self.model.block_kinds):
+            if bi >= 62:
+                break
+            for k in ks:
+                if 0 <= k <= n_kinds:
+                    raw[k] |= np.int64(1 << bi)
+        # Collapse per-kind masks into <= CKO_TIER_PARTS kind CLASSES
+        # (cost-greedy, once per engine): rows then carry one of a small
+        # fixed set of class-union masks, so the static mask tuples jit
+        # sees are bounded and independent of batch composition.
+        distinct = sorted({int(v) for v in raw.tolist() if v})
+        lut = np.zeros(n_kinds + 1, dtype=np.int64)
+        if distinct:
+            clusters = _cluster_masks(
+                [(v, 1) for v in distinct], self.model.block_cost, _TIER_PARTS
+            )
+            to_class = {}
+            for mem, um in clusters:
+                for v in mem:
+                    to_class[v] = um
+            for k in range(n_kinds + 1):
+                lut[k] = to_class.get(int(raw[k]), 0)
+        self._kind_block_lut = lut
         if self.compiled.report.skipped:
             log.info(
                 "compiled with skipped rules",
@@ -337,8 +461,8 @@ class WafEngine:
         else:
             extractions = [self.extractor.extract(r) for r in live]
             tensors = self._tensorize(extractions)
-        tiers, numvals = tier_tensors(tensors)
-        verdicts = self._verdicts_from_tiers(tiers, numvals, len(live))
+        tiers, numvals, masks = self.tier(tensors)
+        verdicts = self._verdicts_from_tiers(tiers, numvals, len(live), masks=masks)
         if not rejected:
             return verdicts
         out: list[Verdict] = []
@@ -347,8 +471,13 @@ class WafEngine:
             out.append(rejected[i] if i in rejected else next(it))
         return out
 
+    def tier(self, tensors):
+        """Row-level (length x kind-partition) tiering with this engine's
+        kind->class-mask table: returns (tiers, numvals, masks)."""
+        return tier_tensors(tensors, self._kind_block_lut)
+
     def _verdicts_from_tiers(
-        self, tiers, numvals, n_requests: int, max_phase: int = 2
+        self, tiers, numvals, n_requests: int, max_phase: int = 2, masks=None
     ) -> list[Verdict]:
         from ..models.waf_model import eval_waf_compact_tiered
 
@@ -356,7 +485,9 @@ class WafEngine:
         # the host path is native (matched is bit-packed on device and the
         # verdict tensors ride a single packed array).
         packed = jax.device_get(
-            eval_waf_compact_tiered(self.model, tiers, numvals, max_phase=max_phase)
+            eval_waf_compact_tiered(
+                self.model, tiers, numvals, max_phase=max_phase, masks=masks
+            )
         )
         return self._decode_packed(packed, n_requests)
 
@@ -399,9 +530,9 @@ class WafEngine:
         self, extractions: list, max_phase: int
     ) -> list[Verdict]:
         tensors = self._tensorize(extractions)
-        tiers, numvals = tier_tensors(tensors)
+        tiers, numvals, masks = self.tier(tensors)
         return self._verdicts_from_tiers(
-            tiers, numvals, len(extractions), max_phase=max_phase
+            tiers, numvals, len(extractions), max_phase=max_phase, masks=masks
         )
 
     def evaluate_phased(self, requests: list[HttpRequest]) -> list[Verdict]:
@@ -448,8 +579,8 @@ def _engine_evaluate_bulk_json(self, body: bytes):
     tensors, n_req, blob = parsed
     if n_req == 0:
         return [], blob
-    tiers, numvals = tier_tensors(tensors)
-    verdicts = self._verdicts_from_tiers(tiers, numvals, n_req)
+    tiers, numvals, masks = self.tier(tensors)
+    verdicts = self._verdicts_from_tiers(tiers, numvals, n_req, masks=masks)
     prog = self.compiled.program
     if prog.request_body_access and prog.request_body_limit_action == "Reject":
         # Parity with the object path: SecRequestBodyLimitAction Reject

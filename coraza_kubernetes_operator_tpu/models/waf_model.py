@@ -45,15 +45,22 @@ from ..ops.transforms import apply_device_pipeline
 
 _BIG = jnp.int32(2**31 - 1)
 
-# Conv-tier match-bitmap element budget (T * (L+2) * N). The default
-# (2^30 ≈ 1.07e9 elements ≈ 3 GB across the bf16 scores + bool bitmap)
-# admits the serving shape 16384 targets x 64 bytes x ~800 segments;
-# long-body buckets beyond it fall back to the DFA scan tier. Setting
+# Conv-tier match-bitmap element budgets (T * (L+2) * N2). A tier whose
+# whole bitmap exceeds the per-chunk budget is row-CHUNKED: the conv
+# matchers run inside a ``lax.map`` over row blocks sized to the budget,
+# so the MXU tier keeps serving arbitrarily many rows at bounded peak
+# HBM (the round-4 trace showed the 19k-row short tier falling off the
+# conv tier into 26 serializing long-bank DFA scans — ~60% of the whole
+# CRS-scale step — because the only options were one giant bitmap or
+# the scan fallback). The DFA long-bank fallback remains for the case a
+# SINGLE row's bitmap exceeds the budget (body-cap-width buffers, where
+# the scan carry's constant memory is the point). Setting
 # CKO_SEG_BITMAP_ELEMENTS=0 disables the fallback entirely (no long
 # banks are built — saves their HBM if length buckets are known-small).
 import os as _os
 
 _SEG_BITMAP_ELEMS = int(_os.environ.get("CKO_SEG_BITMAP_ELEMENTS", str(2**30)))
+_SEG_CHUNK_ELEMS = int(_os.environ.get("CKO_SEG_CHUNK_ELEMENTS", str(2**27)))
 
 
 def _state_bucket(n_states: int) -> int:
@@ -128,6 +135,15 @@ class WafModel:
     # pass walks them sequentially so a ctl rule removed by an earlier
     # ctl never applies its own removals (Coraza in-order semantics).
     removal_rows: tuple = ()
+    # Kind-partitioned matching (static): per matcher block (segs first,
+    # then banks — match_tier's concat order), the tuple of kind ids
+    # that can reach any of the block's groups, and a rough relative
+    # per-row cost. tier_tensors partitions rows by the set of blocks
+    # their kinds can reach; a tier whose mask excludes a block skips
+    # its matcher entirely (hits = False is exact: post_match's `rel`
+    # gate already resolves those links False for such rows).
+    block_kinds: tuple = ()
+    block_cost: tuple = ()
     # Static: some rule has BOTH a counter link and nonzero weights (the
     # ctl:ruleRemoveTargetById variants) — post_match then runs a second
     # counter pass so counter-gated rules' own setvars still accumulate.
@@ -174,6 +190,8 @@ class WafModel:
             self.detection_only,
             self.has_removals,
             self.removal_rows,
+            self.block_kinds,
+            self.block_cost,
             self.two_pass_counters,
         )
         return leaves, aux
@@ -370,6 +388,44 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         )
     )
 
+    # Kind-partitioned matching constants: which kinds can reach each
+    # matcher block (union of the include sets of every string link on
+    # any of the block's groups), and a rough relative per-row cost by
+    # formulation (conv / Pallas VMEM / HBM take-scan / serializing
+    # gather-scan). Only the RANKING matters — tier_tensors uses the
+    # costs to cluster row partitions, never as absolute time.
+    from ..ops.dfa import _PALLAS_VMEM_BUDGET, _pallas_vmem_bytes
+    from ..ops.segment import conv_n2_cols
+
+    gkind_sets: list[set[int]] = [set() for _ in range(max(1, len(crs.groups)))]
+    for link in crs.links:
+        if link.link_type == LINK_STRING and link.group >= 0:
+            gkind_sets[link.group].update(link.include_kinds)
+    block_kinds: list[tuple[int, ...]] = []
+    block_cost: list[float] = []
+    for pid in sorted(seg_groups):
+        ks: set[int] = set()
+        for gid, _plan in seg_groups[pid]:
+            ks |= gkind_sets[gid]
+        block_kinds.append(tuple(sorted(ks)))
+    for seg in segs:
+        block_cost.append(float(conv_n2_cols(seg.spec)))
+    for (pid, _bucket), gids in sorted(buckets.items()):
+        ks = set()
+        for gid in gids:
+            ks |= gkind_sets[gid]
+        block_kinds.append(tuple(sorted(ks)))
+    for bank in banks:
+        s, g = bank.n_states, bank.n_groups
+        if bank.t256.size == 0:
+            block_cost.append(1000.0 * g)  # gather path serializes
+        elif (
+            _pallas_vmem_bytes(s, g, bank.t256.dtype.itemsize, 64)
+            <= _PALLAS_VMEM_BUDGET
+        ):
+            block_cost.append(0.5 * s * max(g, 128))  # VMEM-resident MXU scan
+        else:
+            block_cost.append(8.0 * s * g)  # HBM take-scan
     w_np = np.asarray(weights)
     two_pass_counters = any(
         any(crs.links[l].link_type == LINK_COUNTER for l in r.link_ids)
@@ -417,6 +473,8 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         detection_only=crs.engine_mode == "DetectionOnly",
         has_removals=has_removals,
         removal_rows=removal_rows,
+        block_kinds=tuple(block_kinds),
+        block_cost=tuple(block_cost),
         two_pass_counters=two_pass_counters,
     )
 
@@ -429,27 +487,99 @@ def segment_tier_hits(
     seg_perm,
     data: jnp.ndarray,
     transformed_for,
+    keep: tuple[int, ...] | None = None,
 ) -> list:
     """Hit blocks for the segment-routed groups, choosing the tier per
     TRACE (shapes are static per bucket): the conv tier materializes
-    ~[T, L+2, N] match-bitmap elements — linear in buffer length — so
-    beyond the budget a long-body bucket streams through the
+    ~[T, L+2, N2] match-bitmap elements — linear in buffer length — so
+    beyond the per-chunk budget the rows are processed in ``lax.map``
+    row chunks (same MXU convs, bounded peak HBM); only when a SINGLE
+    row's bitmap exceeds the budget does the bucket stream through the
     constant-memory DFA scan carry instead (same groups, same column
     order after ``seg_perm``). Shared by the single-chip ``eval_waf``
-    and the rule-sharded path (``parallel/mesh.py``)."""
+    and the rule-sharded path (``parallel/mesh.py``).
+
+    ``keep`` (kind-partitioned matching) lists the seg-block indexes the
+    caller's rows can actually reach; skipped blocks contribute all-False
+    hit columns (exact: post_match's ``rel`` gate resolves their links
+    False for such rows). The long-bank fallback ignores ``keep`` — it
+    is the rare giant-buffer path and scans everything."""
     from ..ops.dfa import scan_dfa_bank
     from ..ops.segment import conv_n2_cols, match_segment_block
+
+    if not segs:
+        return []
+    if keep is None:
+        keep = tuple(range(len(segs)))
+    t = data.shape[0]
+
+    def zeros_for(i):
+        return jnp.zeros((t, segs[i].n_groups), dtype=bool)
 
     # Budget on the DUPLICATED column count (conv_n2_cols — what the
     # [T, Q, N2] conv output actually allocates), not the deduped
     # kernel.shape[2]; the gapcls NCE tables are O(T·Q) since the
     # cumsum fallback (ops/segment.py) and need no budget term.
-    n_seg_cols = sum(conv_n2_cols(s.spec) for s in segs)
-    bitmap_elems = data.shape[0] * (data.shape[1] + 2) * max(1, n_seg_cols)
-    use_long = bool(long_banks) and (
-        _SEG_BITMAP_ELEMS > 0 and bitmap_elems > _SEG_BITMAP_ELEMS
-    )
-    if use_long:
+    n_seg_cols = sum(conv_n2_cols(segs[i].spec) for i in keep)
+    per_row = (data.shape[1] + 2) * max(1, n_seg_cols)
+    bitmap_elems = t * per_row
+    rows_fit = max(0, _SEG_CHUNK_ELEMS // max(1, per_row)) // 8 * 8
+    if bitmap_elems <= _SEG_CHUNK_ELEMS or not keep:
+        return [
+            match_segment_block(
+                segs[i].kernel, segs[i].spec, *transformed_for(seg_pipelines[i])
+            )
+            if i in keep
+            else zeros_for(i)
+            for i in range(len(segs))
+        ]
+    if rows_fit >= 8:
+        # Row-chunked conv tier: pad rows to a chunk multiple, stack the
+        # per-pipeline transformed buffers, and run every kept segment
+        # block on one chunk per lax.map step. Padding rows are all-NUL
+        # with length 0 — their hits are computed but never read (uid
+        # indexes only real unique rows).
+        kept = [(i, segs[i], seg_pipelines[i]) for i in keep]
+        pids = sorted({pid for _, _, pid in kept})
+        pid_ix = {pid: i for i, pid in enumerate(pids)}
+        nc = -(-t // rows_fit)
+        tp = nc * rows_fit
+        stacked_d, stacked_l = [], []
+        for pid in pids:
+            td, tl = transformed_for(pid)
+            stacked_d.append(
+                jnp.pad(td, ((0, tp - t), (0, 0))).reshape(nc, rows_fit, td.shape[1])
+            )
+            stacked_l.append(jnp.pad(tl, (0, tp - t)).reshape(nc, rows_fit))
+
+        def one_chunk(args):
+            ds, ls = args
+            return jnp.concatenate(
+                [
+                    match_segment_block(
+                        seg.kernel, seg.spec, ds[pid_ix[pid]], ls[pid_ix[pid]]
+                    )
+                    for _, seg, pid in kept
+                ],
+                axis=1,
+            )
+
+        hits = jax.lax.map(
+            one_chunk,
+            (jnp.stack(stacked_d, axis=1), jnp.stack(stacked_l, axis=1)),
+        )
+        hits = hits.reshape(tp, hits.shape[2])[:t]
+        # Reassemble full column order, zero blocks for skipped segs.
+        out, off = [], 0
+        for i in range(len(segs)):
+            if i in keep:
+                g = segs[i].n_groups
+                out.append(hits[:, off : off + g])
+                off += g
+            else:
+                out.append(zeros_for(i))
+        return out
+    if bool(long_banks) and _SEG_BITMAP_ELEMS > 0:
         long_cols = [
             scan_dfa_bank(bank, *transformed_for(pid))
             for bank, pid in zip(long_banks, long_bank_pipelines)
@@ -463,9 +593,14 @@ def segment_tier_hits(
             )
             > 0
         ]  # [T, Gs] in seg-column order
+    # Fallback disabled (or no long banks): direct conv regardless.
     return [
-        match_segment_block(seg.kernel, seg.spec, *transformed_for(pid))
-        for seg, pid in zip(segs, seg_pipelines)
+        match_segment_block(
+            segs[i].kernel, segs[i].spec, *transformed_for(seg_pipelines[i])
+        )
+        if i in keep
+        else zeros_for(i)
+        for i in range(len(segs))
     ]
 
 
@@ -505,15 +640,28 @@ def match_tier(
     lengths: jnp.ndarray,  # [T]
     variant_data: jnp.ndarray,  # [H, T, L]
     variant_lengths: jnp.ndarray,  # [H, T]
+    mask: int | None = None,
 ) -> jnp.ndarray:
     """Stages 1+2 for ONE length tier: transforms + matchers → per-target
     group hits [T, G]. Segment blocks first, DFA banks after — the same
     global order build_model's remap assigned. Tiers are independent
     until post_match (rows only meet at the req_id reduction), which is
-    what makes row-level length tiering (``eval_waf_tiered``) sound."""
+    what makes row-level length tiering (``eval_waf_tiered``) sound.
+
+    ``mask`` (static int) is the kind-partition block bitmask: bit i set
+    = scan block i (segs first, then banks — build_model order). Blocks
+    beyond bit 62 are always scanned (saturation for huge models). A
+    skipped block contributes all-False hits, which is exact for rows
+    whose kinds cannot reach the block's groups (``rel`` in post_match
+    gates those links off regardless of the hit bit)."""
     per_block: list[jnp.ndarray] = []
     transformed: dict[int, tuple[jnp.ndarray, jnp.ndarray]] = {}
     from ..ops.dfa import scan_dfa_bank
+
+    n_segs = len(model.segs)
+
+    def block_on(i: int) -> bool:
+        return mask is None or i >= 62 or (mask >> i) & 1 == 1
 
     def transformed_for(pid: int) -> tuple[jnp.ndarray, jnp.ndarray]:
         if pid not in transformed:
@@ -535,9 +683,15 @@ def match_tier(
             model.seg_perm,
             data,
             transformed_for,
+            keep=tuple(i for i in range(n_segs) if block_on(i)),
         )
     )
-    for bank, pid in zip(model.banks, model.bank_pipelines):
+    for bi, (bank, pid) in enumerate(zip(model.banks, model.bank_pipelines)):
+        if not block_on(n_segs + bi):
+            per_block.append(
+                jnp.zeros((data.shape[0], bank.n_groups), dtype=bool)
+            )
+            continue
         tdata, tlen = transformed_for(pid)
         per_block.append(scan_dfa_bank(bank, tdata, tlen))
     if per_block:
@@ -545,8 +699,8 @@ def match_tier(
     return jnp.zeros((data.shape[0], 1), dtype=bool)
 
 
-@partial(jax.jit, static_argnames=("max_phase",))
-def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2):
+@partial(jax.jit, static_argnames=("max_phase", "masks"))
+def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2, masks=None):
     """Row-level length-tiered, value-deduped evaluation. ``tiers`` is a
     tuple of ``(data, lengths, kind1, kind2, kind3, req_id, vdata,
     vlengths, uid)`` per length class (``engine.waf.tier_tensors``):
@@ -558,10 +712,17 @@ def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2):
     back to per-(target, kinds) pair rows by index, and one global
     post_match reduces all pair rows by req_id. Request atomicity holds
     because req_id is global across tiers and post_match is the only
-    cross-row stage."""
+    cross-row stage.
+
+    ``masks`` (static tuple, len(tiers), entries int or None) carries
+    each tier's kind-partition block bitmask (``match_tier``): tiers are
+    further partitioned by which matcher blocks their rows' kinds can
+    reach, so e.g. header-only rows never scan arg-only banks."""
     hits, k1s, k2s, k3s, rids = [], [], [], [], []
-    for (data, lengths, k1, k2, k3, rid, vd, vl, uid) in tiers:
-        hits_u = match_tier(model, data, lengths, vd, vl)
+    if masks is None:
+        masks = (None,) * len(tiers)
+    for (data, lengths, k1, k2, k3, rid, vd, vl, uid), mask in zip(tiers, masks):
+        hits_u = match_tier(model, data, lengths, vd, vl, mask=mask)
         hits.append(jnp.take(hits_u, uid, axis=0))  # [P, G] pair rows
         k1s.append(k1)
         k2s.append(k2)
@@ -811,11 +972,15 @@ def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
     return _pack_verdicts(eval_waf.__wrapped__(model, *tensors, max_phase=max_phase))
 
 
-@partial(jax.jit, static_argnames=("max_phase",))
-def eval_waf_compact_tiered(model: WafModel, tiers, numvals, max_phase: int = 2):
+@partial(jax.jit, static_argnames=("max_phase", "masks"))
+def eval_waf_compact_tiered(
+    model: WafModel, tiers, numvals, max_phase: int = 2, masks=None
+):
     """eval_waf_tiered + ``_pack_verdicts`` in one dispatch."""
     return _pack_verdicts(
-        eval_waf_tiered.__wrapped__(model, tiers, numvals, max_phase=max_phase)
+        eval_waf_tiered.__wrapped__(
+            model, tiers, numvals, max_phase=max_phase, masks=masks
+        )
     )
 
 

@@ -332,10 +332,10 @@ def test_long_body_falls_back_to_dfa_tier(monkeypatch):
     # run would silently reuse the first tier's executable.
     import jax
 
-    monkeypatch.setattr(waf_model, "_SEG_BITMAP_ELEMS", 1)
+    monkeypatch.setattr(waf_model, "_SEG_CHUNK_ELEMS", 1)
     jax.clear_caches()
     long_verdicts = [eng.evaluate_one(r) for r in reqs]
-    monkeypatch.setattr(waf_model, "_SEG_BITMAP_ELEMS", 2**62)
+    monkeypatch.setattr(waf_model, "_SEG_CHUNK_ELEMS", 2**62)
     jax.clear_caches()
     conv_verdicts = [eng.evaluate_one(r) for r in reqs]
 

@@ -99,7 +99,7 @@ def test_sharded_long_body_fallback(monkeypatch):
     single = WafEngine(compiled)
     expected = single.evaluate(reqs)
 
-    monkeypatch.setattr(waf_model, "_SEG_BITMAP_ELEMS", 1)  # force long tier
+    monkeypatch.setattr(waf_model, "_SEG_CHUNK_ELEMS", 1)  # force long tier
     _jax.clear_caches()
     try:
         sharded = ShardedWafEngine(compiled=compiled, mesh=make_mesh(2, 1))

@@ -274,3 +274,55 @@ def test_native_json_still_accepts_valid(limit_engine):
     assert out is not None
     verdicts, _ = out
     assert len(verdicts) == 1 and not verdicts[0].interrupted
+
+
+# -- row-chunked conv tier ----------------------------------------------------
+
+
+def test_seg_row_chunking_matches_direct(monkeypatch):
+    """A tier whose bitmap exceeds the per-chunk budget runs the SAME
+    conv matchers in lax.map row chunks — verdicts and matched sets must
+    be identical to the direct path (waf_model.segment_tier_hits)."""
+    import jax
+
+    from coraza_kubernetes_operator_tpu.models import waf_model
+
+    rules = BASE + (
+        'SecRule ARGS "@rx (?i:\\bunion\\s+select\\b)" "id:1,phase:2,deny,status:403,t:none,t:urlDecodeUni"\n'
+        'SecRule ARGS "@contains evilmonkey" "id:2,phase:2,deny,status:403,t:none"\n'
+        'SecRule REQUEST_HEADERS:User-Agent "@pm sqlmap nikto" "id:3,phase:1,deny,status:403,t:none,t:lowercase"\n'
+    )
+    eng = WafEngine(rules)
+    reqs = []
+    for i in range(8):
+        reqs += [
+            HttpRequest(uri=f"/?q=union+select+a{i}"),
+            HttpRequest(uri=f"/?q=benign+value+{i}"),
+            HttpRequest(uri=f"/?note=evilmonkey{i}"),
+            HttpRequest(uri=f"/{i}", headers=[("User-Agent", "sqlmap/1.0")]),
+        ]
+
+    direct = eng.evaluate(reqs)
+    # Per-chunk budget small enough that the ~100-row tier needs several
+    # chunks, but >= 8 rows/chunk (for any tier width up to 64) so the
+    # chunked path — not the long-bank fallback — is selected.
+    from coraza_kubernetes_operator_tpu.ops.segment import conv_n2_cols
+
+    n2 = sum(conv_n2_cols(s.spec) for s in eng.model.segs)
+    assert n2 > 0
+    monkeypatch.setattr(waf_model, "_SEG_CHUNK_ELEMS", 16 * 66 * n2)
+    jax.clear_caches()
+    try:
+        chunked = eng.evaluate(reqs)
+    finally:
+        jax.clear_caches()
+
+    for j, (d, c) in enumerate(zip(direct, chunked)):
+        assert d.interrupted == c.interrupted, j
+        assert d.status == c.status, j
+        assert d.rule_id == c.rule_id, j
+        assert d.matched_ids == c.matched_ids, j
+    assert direct[0].interrupted and direct[0].rule_id == 1
+    assert direct[1].allowed
+    assert direct[2].interrupted and direct[2].rule_id == 2
+    assert direct[3].interrupted and direct[3].rule_id == 3
