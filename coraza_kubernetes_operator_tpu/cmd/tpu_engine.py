@@ -57,6 +57,14 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
     p.add_argument(
         "--max-batch-delay-ms", type=float, default=DEFAULT_MAX_BATCH_DELAY_MS
     )
+    p.add_argument("--request-timeout-seconds", type=float, default=30.0)
+    p.add_argument(
+        "--compile-timeout-seconds",
+        type=float,
+        default=600.0,
+        help="first-evaluation budget while a freshly loaded ruleset's XLA"
+        " executables compile; the strict request timeout applies afterwards",
+    )
     p.add_argument("--bind-address", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9090)
     p.add_argument(
@@ -87,6 +95,8 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         max_batch_delay_ms=args.max_batch_delay_ms,
         host=args.bind_address,
         port=args.port,
+        request_timeout_s=args.request_timeout_seconds,
+        compile_timeout_s=args.compile_timeout_seconds,
         audit_log=args.audit_log or None,
         audit_relevant_only=not args.audit_all,
     )

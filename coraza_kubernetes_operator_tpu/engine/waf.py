@@ -283,6 +283,11 @@ class WafEngine:
                 if link.group >= 0 and self.compiled.group_pipeline[link.group] == pid:
                     kinds.update(link.include_kinds)
             self._host_pipeline_kinds.append(kinds)
+        # False until the first device batch completes — i.e. while XLA is
+        # still compiling this model's executables. The sidecar widens its
+        # request timeout for cold engines (server._timeout_for) so a
+        # freshly loaded CRS-scale ruleset never times out mid-compile.
+        self.warmed = False
         # Native host runtime (C++ extraction + tensorization); falls back
         # to the Python path when the library is absent or the ruleset uses
         # transforms the native tier does not implement.
@@ -489,6 +494,7 @@ class WafEngine:
                 self.model, tiers, numvals, max_phase=max_phase, masks=masks
             )
         )
+        self.warmed = True
         return self._decode_packed(packed, n_requests)
 
     def _decode_packed(self, packed, n_requests: int) -> list[Verdict]:

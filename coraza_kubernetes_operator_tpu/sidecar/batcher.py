@@ -106,6 +106,10 @@ class MicroBatcher:
         ] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._running = False
+        # True while a window is being evaluated on device. Lets waiters
+        # distinguish "stuck" from "a (re)compile or big step is in
+        # flight" and extend their timeout instead of failing mid-compile.
+        self.busy = False
         self.stats = BatcherStats()
 
     def start(self) -> None:
@@ -139,6 +143,10 @@ class MicroBatcher:
         self._queue.put((request, tenant, fut))
         return fut
 
+    def pending(self) -> int:
+        """Requests queued but not yet picked into a window."""
+        return self._queue.qsize()
+
     def evaluate(
         self, request: HttpRequest, timeout_s: float = 30.0, tenant: str | None = None
     ) -> Verdict:
@@ -167,7 +175,11 @@ class MicroBatcher:
                 if nxt is None:
                     break
                 window.append(nxt)
-            self._evaluate_window(window)
+            self.busy = True
+            try:
+                self._evaluate_window(window)
+            finally:
+                self.busy = False
 
     def _evaluate_window(
         self, window: list[tuple[HttpRequest, str | None, Future]]
