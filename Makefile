@@ -64,7 +64,9 @@ bench.smoke:  ## Fast single-config bench (presubmit gate; strict exit).
 	BENCH_CONFIGS=1 BENCH_ITERS=2 BENCH_STRICT=1 $(PYTHON) bench.py
 
 .PHONY: presubmit
-presubmit:  ## Gate before any end-of-round snapshot: fast tier + smoke bench.
+presubmit:  ## Gate before any end-of-round snapshot: warm-cache freshness FIRST (pytest/bench write entries and would mask staleness), then fast tier + smoke bench.
+	$(PYTHON) hack/check_cache_fresh.py tests/.jax_cache --hint 'run make test over the FINAL code and commit tests/.jax_cache'
+	$(PYTHON) hack/check_cache_fresh.py .jax_bench_cache --hint 'run make bench.warm LAST, after every engine change'
 	$(PYTHON) -m pytest tests/ -x -q
 	$(MAKE) bench.smoke
 

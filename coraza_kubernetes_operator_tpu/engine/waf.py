@@ -56,7 +56,12 @@ _MIN_TIER_ROWS = 256
 import os as _os
 
 _TIER_PARTS = int(_os.environ.get("CKO_TIER_PARTS", "3"))
-_MIN_PART_ROWS = 256
+# Partitions below this row count merge into the largest partition: every
+# extra partition is another full matcher trace (compile time) and
+# another set of per-stage fixed costs (the flat fused scans made stages
+# cheaper but not free — round-5 profiling: 11 partitions cost more in
+# stage overhead than their block-skipping saved).
+_MIN_PART_ROWS = int(_os.environ.get("CKO_MIN_PART_ROWS", "1024"))
 
 
 def _mask_cost(mask: int, block_cost) -> float:
@@ -106,7 +111,7 @@ def _cluster_masks(values_counts, block_cost, max_parts: int):
     return [(mem, um) for mem, um, _rows in clusters]
 
 
-def tier_tensors(tensors, kind_lut=None):
+def tier_tensors(tensors, kind_lut=None, cache=None):
     """Split one wide tensorized batch into row-level (length x kind
     partition) tiers.
 
@@ -122,7 +127,15 @@ def tier_tensors(tensors, kind_lut=None):
     tensorizer — both produce identical row layouts); output is
     ``(tiers, numvals, masks)`` where tiers is a tuple of per-tier
     9-tuples for ``eval_waf_tiered`` and masks the aligned static
-    block-bitmask tuple (entries None when kind_lut is absent)."""
+    block-bitmask tuple (entries None when kind_lut is absent).
+
+    With ``cache`` (a ``ValueHitCache``), unique rows whose key was
+    matched in an earlier batch skip the matcher: the return grows to
+    ``(tiers, numvals, masks, cached, miss_keys)`` where cached[i] is
+    the tier's bit-packed cached hit rows (or None) and miss_keys[i]
+    the keys of the tier's matcher rows (for population after the
+    batch). Tier uid indexes the concatenation [matcher rows (bucketed)
+    | cached rows]."""
     data, lengths, k1, k2, k3, req_id, numvals, vdata, vlengths = tensors
     n_req = numvals.shape[0]
     h = vdata.shape[0]
@@ -145,6 +158,8 @@ def tier_tensors(tensors, kind_lut=None):
             raw.append((b, sel))
     tiers = []
     masks: list[int | None] = []
+    cached: list[np.ndarray | None] = []
+    miss_keys: list[list[bytes]] = []
 
     def emit(sel: np.ndarray, length: int, mask: int | None):
         # VALUE DEDUP: the matcher's output depends only on (bytes,
@@ -164,7 +179,34 @@ def tier_tensors(tensors, kind_lut=None):
             return_index=True,
             return_inverse=True,
         )
-        usel = sel[first_idx]  # representative original row per unique value
+
+        if cache is None:
+            usel = sel[first_idx]  # representative row per unique value
+            remap = None
+            cpk = None
+            mkeys: list[bytes] = []
+        else:
+            # CROSS-BATCH VALUE CACHE: unique rows seen in an earlier
+            # batch skip the matcher. Key = partition mask (it decides
+            # which hit columns are live) + the dedup key bytes.
+            prefix = int(-1 if mask is None else mask).to_bytes(
+                8, "little", signed=True
+            )
+            ukeys = [prefix + keys[i].tobytes() for i in first_idx]
+            found, miss = cache.lookup(ukeys)
+            usel = sel[first_idx[miss]] if miss else sel[:0]
+            mkeys = [ukeys[j] for j in miss]
+            u_pad = _bucket_rows(max(1, usel.size))
+            cpk = np.zeros(
+                (_bucket_rows(max(1, len(found))), cache.packed_len),
+                dtype=np.uint8,
+            )
+            remap = np.zeros(len(ukeys), dtype=np.int32)
+            for r, j in enumerate(miss):
+                remap[j] = r
+            for r, (j, row) in enumerate(sorted(found.items())):
+                cpk[r] = row
+                remap[j] = u_pad + r
 
         u = _bucket_rows(max(1, usel.size))
         d = np.zeros((u, length), dtype=np.uint8)
@@ -173,7 +215,7 @@ def tier_tensors(tensors, kind_lut=None):
         lg[: usel.size] = lengths[usel]
         vd = np.zeros((max(h, 1), u, length), dtype=np.uint8)
         vl = np.zeros((max(h, 1), u), dtype=np.int32)
-        if h:
+        if h and usel.size:
             vd[:, : usel.size] = vdata[:, usel, :length]
             vl[:, : usel.size] = vlengths[:, usel]
 
@@ -186,9 +228,11 @@ def tier_tensors(tensors, kind_lut=None):
         rid = np.full(p, n_req, dtype=np.int32)
         rid[: sel.size] = req_id[sel]
         uid = np.zeros(p, dtype=np.int32)  # pad pairs read unique row 0
-        uid[: sel.size] = inverse
+        uid[: sel.size] = inverse if remap is None else remap[inverse]
         tiers.append((d, lg, kk[0], kk[1], kk[2], rid, vd, vl, uid))
         masks.append(mask)
+        cached.append(cpk)
+        miss_keys.append(mkeys)
 
     i = 0
     while i < len(raw):
@@ -227,7 +271,9 @@ def tier_tensors(tensors, kind_lut=None):
             for s, um in parts:
                 emit(s, length, int(um))
         i += 1
-    return tuple(tiers), numvals, tuple(masks)
+    if cache is None:
+        return tuple(tiers), numvals, tuple(masks)
+    return tuple(tiers), numvals, tuple(masks), tuple(cached), miss_keys
 
 
 @dataclass
@@ -311,11 +357,21 @@ class WafEngine:
         # (cost-greedy, once per engine): rows then carry one of a small
         # fixed set of class-union masks, so the static mask tuples jit
         # sees are bounded and independent of batch composition.
-        distinct = sorted({int(v) for v in raw.tolist() if v})
+        # Weight each distinct mask by how many kinds map to it — the
+        # greedy clustering then biases class unions toward masks many
+        # kinds (hence likely many rows) carry, instead of treating a
+        # rare kind combo the same as a hot one (ADVICE r4).
+        mask_kinds: dict[int, int] = {}
+        for v in raw.tolist():
+            if v:
+                mask_kinds[int(v)] = mask_kinds.get(int(v), 0) + 1
+        distinct = sorted(mask_kinds)
         lut = np.zeros(n_kinds + 1, dtype=np.int64)
         if distinct:
             clusters = _cluster_masks(
-                [(v, 1) for v in distinct], self.model.block_cost, _TIER_PARTS
+                [(v, mask_kinds[v]) for v in distinct],
+                self.model.block_cost,
+                _TIER_PARTS,
             )
             to_class = {}
             for mem, um in clusters:
@@ -324,6 +380,21 @@ class WafEngine:
             for k in range(n_kinds + 1):
                 lut[k] = to_class.get(int(raw[k]), 0)
         self._kind_block_lut = lut
+        # Cross-batch value-hit cache (engine/value_cache.py): matcher
+        # results memoized by (partition mask, value bytes).
+        # CKO_VALUE_CACHE_MB sets the byte budget (default 256MB; 0
+        # disables).
+        from .value_cache import ValueHitCache
+
+        g_total = sum(s.n_groups for s in self.model.segs) + sum(
+            b.n_groups for b in self.model.banks
+        )
+        cache_mb = int(_os.environ.get("CKO_VALUE_CACHE_MB", "256"))
+        self.value_cache = (
+            ValueHitCache((max(1, g_total) + 7) // 8, cache_mb * 2**20)
+            if cache_mb > 0
+            else None
+        )
         if self.compiled.report.skipped:
             log.info(
                 "compiled with skipped rules",
@@ -466,8 +537,10 @@ class WafEngine:
         else:
             extractions = [self.extractor.extract(r) for r in live]
             tensors = self._tensorize(extractions)
-        tiers, numvals, masks = self.tier(tensors)
-        verdicts = self._verdicts_from_tiers(tiers, numvals, len(live), masks=masks)
+        tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
+        verdicts = self._verdicts_from_tiers(
+            tiers, numvals, len(live), masks=masks, cached=cached, miss_keys=mkeys
+        )
         if not rejected:
             return verdicts
         out: list[Verdict] = []
@@ -481,19 +554,48 @@ class WafEngine:
         kind->class-mask table: returns (tiers, numvals, masks)."""
         return tier_tensors(tensors, self._kind_block_lut)
 
+    def tier_cached(self, tensors):
+        """Like ``tier`` but consulting the cross-batch value cache:
+        returns (tiers, numvals, masks, cached, miss_keys). Identical to
+        ``tier`` + all-miss when the cache is disabled."""
+        if self.value_cache is None:
+            tiers, numvals, masks = tier_tensors(tensors, self._kind_block_lut)
+            return tiers, numvals, masks, None, None
+        return tier_tensors(
+            tensors, self._kind_block_lut, cache=self.value_cache
+        )
+
     def _verdicts_from_tiers(
-        self, tiers, numvals, n_requests: int, max_phase: int = 2, masks=None
+        self,
+        tiers,
+        numvals,
+        n_requests: int,
+        max_phase: int = 2,
+        masks=None,
+        cached=None,
+        miss_keys=None,
     ) -> list[Verdict]:
         from ..models.waf_model import eval_waf_compact_tiered
 
         # One small transfer: device->host readback dominates serving once
         # the host path is native (matched is bit-packed on device and the
         # verdict tensors ride a single packed array).
-        packed = jax.device_get(
-            eval_waf_compact_tiered(
-                self.model, tiers, numvals, max_phase=max_phase, masks=masks
-            )
+        out = eval_waf_compact_tiered(
+            self.model,
+            tiers,
+            numvals,
+            max_phase=max_phase,
+            masks=masks,
+            cached=cached,
         )
+        if cached is None:
+            packed = jax.device_get(out)
+        else:
+            packed, tier_hits = jax.device_get(out)
+            if self.value_cache is not None and miss_keys is not None:
+                for keys, hp in zip(miss_keys, tier_hits):
+                    if keys:
+                        self.value_cache.insert(keys, hp[: len(keys)])
         self.warmed = True
         return self._decode_packed(packed, n_requests)
 
@@ -536,9 +638,15 @@ class WafEngine:
         self, extractions: list, max_phase: int
     ) -> list[Verdict]:
         tensors = self._tensorize(extractions)
-        tiers, numvals, masks = self.tier(tensors)
+        tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
         return self._verdicts_from_tiers(
-            tiers, numvals, len(extractions), max_phase=max_phase, masks=masks
+            tiers,
+            numvals,
+            len(extractions),
+            max_phase=max_phase,
+            masks=masks,
+            cached=cached,
+            miss_keys=mkeys,
         )
 
     def evaluate_phased(self, requests: list[HttpRequest]) -> list[Verdict]:
@@ -585,8 +693,10 @@ def _engine_evaluate_bulk_json(self, body: bytes):
     tensors, n_req, blob = parsed
     if n_req == 0:
         return [], blob
-    tiers, numvals, masks = self.tier(tensors)
-    verdicts = self._verdicts_from_tiers(tiers, numvals, n_req, masks=masks)
+    tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
+    verdicts = self._verdicts_from_tiers(
+        tiers, numvals, n_req, masks=masks, cached=cached, miss_keys=mkeys
+    )
     prog = self.compiled.program
     if prog.request_body_access and prog.request_body_limit_action == "Reject":
         # Parity with the object path: SecRequestBodyLimitAction Reject

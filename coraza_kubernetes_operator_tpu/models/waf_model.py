@@ -57,6 +57,12 @@ _BIG = jnp.int32(2**31 - 1)
 # the scan carry's constant memory is the point). Setting
 # CKO_SEG_BITMAP_ELEMENTS=0 disables the fallback entirely (no long
 # banks are built — saves their HBM if length buckets are known-small).
+#
+# BEHAVIOR CHANGE (round 4): CKO_SEG_BITMAP_ELEMENTS no longer
+# thresholds conv-vs-DFA dispatch — only 0 vs nonzero matters (build the
+# long-bank fallback or not). The dispatch threshold is
+# CKO_SEG_CHUNK_ELEMENTS; pre-round-4 tunings of the old knob's numeric
+# value are no-ops and should move to CKO_SEG_CHUNK_ELEMENTS.
 import os as _os
 
 _SEG_BITMAP_ELEMS = int(_os.environ.get("CKO_SEG_BITMAP_ELEMENTS", str(2**30)))
@@ -121,6 +127,10 @@ class WafModel:
     # so long buckets stream through the constant-memory scan carry.
     long_banks: list = field(default_factory=list)
     seg_perm: jnp.ndarray | None = None  # [Gs, Gs] one-hot: long order → seg order
+    # Flat-slot fused bank bins (ops/dfa_flat.py): cover most DFA banks
+    # with a few fused VMEM-resident scans; covered banks' legacy scans
+    # are skipped in match_tier. Empty when fusion is disabled.
+    flat_banks: list = field(default_factory=list)
     # static metadata
     bank_pipelines: tuple = field(default_factory=tuple)  # pipeline id per bank
     seg_pipelines: tuple = field(default_factory=tuple)  # pipeline id per seg block
@@ -148,6 +158,8 @@ class WafModel:
     # ctl:ruleRemoveTargetById variants) — post_match then runs a second
     # counter pass so counter-gated rules' own setvars still accumulate.
     two_pass_counters: bool = False
+    # Static: block indexes whose hit columns come from flat_banks.
+    flat_covered: tuple = ()
 
     def tree_flatten(self):
         leaves = (
@@ -178,6 +190,7 @@ class WafModel:
             self.counter_base,
             self.long_banks,
             self.seg_perm,
+            self.flat_banks,
         )
         aux = (
             self.bank_pipelines,
@@ -193,6 +206,7 @@ class WafModel:
             self.block_kinds,
             self.block_cost,
             self.two_pass_counters,
+            self.flat_covered,
         )
         return leaves, aux
 
@@ -251,12 +265,34 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
 
     banks: list[DFABank] = []
     bank_pipelines: list[int] = []
+    bank_gids: list[list[int]] = []
     for (pid, _bucket), gids in sorted(buckets.items()):
         banks.append(stack_dfas([crs.groups[g].dfa for g in gids]))
         bank_pipelines.append(pid)
+        bank_gids.append(list(gids))
         for g in gids:
             remap[g] = next_new
             next_new += 1
+
+    # Flat-slot fused bank bins (ops/dfa_flat.py): most banks' scans
+    # collapse into a few VMEM-resident fused kernels (CKO_FLAT=0
+    # disables — the legacy per-bank dispatch in ops/dfa.py remains the
+    # fallback for rejected banks and the sharded path).
+    n_segs_blocks = len(segs)
+    flat_banks_built: list = []
+    flat_covered: set[int] = set()
+    if _os.environ.get("CKO_FLAT", "1") != "0" and banks:
+        from ..ops.dfa_flat import build_flat_bank, plan_flat_bins
+
+        bank_dfas = [
+            (n_segs_blocks + bi, bank_pipelines[bi], [crs.groups[g].dfa for g in bank_gids[bi]])
+            for bi in range(len(banks))
+        ]
+        bins, _rejected = plan_flat_bins(bank_dfas)
+        for bn in bins:
+            flat_banks_built.append(build_flat_bank(bn))
+            for block_idx, _pid, _glo, _ghi, _ds in bn:
+                flat_covered.add(block_idx)
 
     # Long-buffer fallback banks: every segment-routed group's DFA,
     # bucketed by state count like the normal banks. Their concatenated
@@ -415,9 +451,11 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         for gid in gids:
             ks |= gkind_sets[gid]
         block_kinds.append(tuple(sorted(ks)))
-    for bank in banks:
+    for bi, bank in enumerate(banks):
         s, g = bank.n_states, bank.n_groups
-        if bank.t256.size == 0:
+        if n_segs_blocks + bi in flat_covered:
+            block_cost.append(0.5 * s * g)  # fused flat scan, no lane padding
+        elif bank.t256.size == 0:
             block_cost.append(1000.0 * g)  # gather path serializes
         elif (
             _pallas_vmem_bytes(s, g, bank.t256.dtype.itemsize, 64)
@@ -463,6 +501,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         ),
         long_banks=long_banks,
         seg_perm=seg_perm,
+        flat_banks=flat_banks_built,
         bank_pipelines=tuple(bank_pipelines),
         seg_pipelines=tuple(seg_pipelines),
         long_bank_pipelines=tuple(long_bank_pipelines),
@@ -476,6 +515,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         block_kinds=tuple(block_kinds),
         block_cost=tuple(block_cost),
         two_pass_counters=two_pass_counters,
+        flat_covered=tuple(sorted(flat_covered)),
     )
 
 
@@ -649,8 +689,9 @@ def match_tier(
     what makes row-level length tiering (``eval_waf_tiered``) sound.
 
     ``mask`` (static int) is the kind-partition block bitmask: bit i set
-    = scan block i (segs first, then banks — build_model order). Blocks
-    beyond bit 62 are always scanned (saturation for huge models). A
+    = scan block i (segs first, then banks — build_model order). Bits
+    0-61 are usable; blocks at index >= 62 are always scanned
+    (saturation for huge models). A
     skipped block contributes all-False hits, which is exact for rows
     whose kinds cannot reach the block's groups (``rel`` in post_match
     gates those links off regardless of the hit bit)."""
@@ -686,10 +727,35 @@ def match_tier(
             keep=tuple(i for i in range(n_segs) if block_on(i)),
         )
     )
+    # Flat-slot fused bins: one fused scan covers many banks. A bin runs
+    # when ANY of its blocks is mask-on; mask-off blocks' columns are
+    # discarded (the stitcher emits zeros for them below, which is exact
+    # — post_match's rel gate resolves those links False regardless).
+    flat_cols: dict[int, dict[int, jnp.ndarray]] = {}
+    if model.flat_banks:
+        from ..ops.dfa_flat import scan_flat_bank
+
+        for fb in model.flat_banks:
+            if not any(block_on(p[0]) for p in fb.pieces):
+                continue
+            sub = {p: transformed_for(p) for p in sorted(set(fb.seg_pipes))}
+            out = scan_flat_bank(fb, sub)
+            col = 0
+            for blk, g_lo, g_hi in fb.pieces:
+                w = g_hi - g_lo
+                flat_cols.setdefault(blk, {})[g_lo] = out[:, col : col + w]
+                col += w
     for bi, (bank, pid) in enumerate(zip(model.banks, model.bank_pipelines)):
-        if not block_on(n_segs + bi):
+        blk = n_segs + bi
+        if not block_on(blk):
             per_block.append(
                 jnp.zeros((data.shape[0], bank.n_groups), dtype=bool)
+            )
+            continue
+        if blk in model.flat_covered:
+            pieces = flat_cols[blk]
+            per_block.append(
+                jnp.concatenate([pieces[k] for k in sorted(pieces)], axis=1)
             )
             continue
         tdata, tlen = transformed_for(pid)
@@ -699,8 +765,18 @@ def match_tier(
     return jnp.zeros((data.shape[0], 1), dtype=bool)
 
 
+def _unpack_hit_rows(packed: jnp.ndarray, g: int) -> jnp.ndarray:
+    """[U, PB] uint8 (big bit order, np.packbits layout) -> [U, G] bool."""
+    u, pb = packed.shape
+    shifts = 7 - jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & 1
+    return bits.reshape(u, pb * 8)[:, :g].astype(bool)
+
+
 @partial(jax.jit, static_argnames=("max_phase", "masks"))
-def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2, masks=None):
+def eval_waf_tiered(
+    model: WafModel, tiers, numvals, max_phase: int = 2, masks=None, cached=None
+):
     """Row-level length-tiered, value-deduped evaluation. ``tiers`` is a
     tuple of ``(data, lengths, kind1, kind2, kind3, req_id, vdata,
     vlengths, uid)`` per length class (``engine.waf.tier_tensors``):
@@ -717,18 +793,39 @@ def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2, masks=N
     ``masks`` (static tuple, len(tiers), entries int or None) carries
     each tier's kind-partition block bitmask (``match_tier``): tiers are
     further partitioned by which matcher blocks their rows' kinds can
-    reach, so e.g. header-only rows never scan arg-only banks."""
+    reach, so e.g. header-only rows never scan arg-only banks.
+
+    ``cached`` (aligned tuple, entries [Uc, PB] uint8 or None) carries
+    each tier's cross-batch cached hit rows (``engine.value_cache``):
+    tier uid then indexes [matcher rows | cached rows], so cached rows
+    never touch a matcher. Returns the verdict dict; the per-tier
+    matcher-row hits ride along under "_tier_hits" when ``cached`` is
+    given (the engine bit-packs and stores them after the batch)."""
     hits, k1s, k2s, k3s, rids = [], [], [], [], []
     if masks is None:
         masks = (None,) * len(tiers)
-    for (data, lengths, k1, k2, k3, rid, vd, vl, uid), mask in zip(tiers, masks):
+    elif len(masks) != len(tiers):
+        # Static check at trace time: a short masks tuple would silently
+        # zip-drop trailing tiers from evaluation (missed matches).
+        raise ValueError(
+            f"masks length {len(masks)} != tiers length {len(tiers)}"
+        )
+    tier_hits = []
+    for ti, ((data, lengths, k1, k2, k3, rid, vd, vl, uid), mask) in enumerate(
+        zip(tiers, masks)
+    ):
         hits_u = match_tier(model, data, lengths, vd, vl, mask=mask)
+        if cached is not None:
+            tier_hits.append(hits_u)
+            if cached[ti] is not None:
+                ch = _unpack_hit_rows(cached[ti], hits_u.shape[1])
+                hits_u = jnp.concatenate([hits_u, ch], axis=0)
         hits.append(jnp.take(hits_u, uid, axis=0))  # [P, G] pair rows
         k1s.append(k1)
         k2s.append(k2)
         k3s.append(k3)
         rids.append(rid)
-    return post_match(
+    out = post_match(
         model,
         jnp.concatenate(hits, axis=0),
         jnp.concatenate(k1s),
@@ -738,6 +835,9 @@ def eval_waf_tiered(model: WafModel, tiers, numvals, max_phase: int = 2, masks=N
         numvals,
         max_phase,
     )
+    if cached is not None:
+        out["_tier_hits"] = tuple(tier_hits)
+    return out
 
 
 def post_match(
@@ -974,14 +1074,22 @@ def eval_waf_compact(model: WafModel, *tensors, max_phase: int = 2):
 
 @partial(jax.jit, static_argnames=("max_phase", "masks"))
 def eval_waf_compact_tiered(
-    model: WafModel, tiers, numvals, max_phase: int = 2, masks=None
+    model: WafModel, tiers, numvals, max_phase: int = 2, masks=None, cached=None
 ):
-    """eval_waf_tiered + ``_pack_verdicts`` in one dispatch."""
-    return _pack_verdicts(
-        eval_waf_tiered.__wrapped__(
-            model, tiers, numvals, max_phase=max_phase, masks=masks
-        )
+    """eval_waf_tiered + ``_pack_verdicts`` in one dispatch. With
+    ``cached``, also returns the per-tier matcher-row hits bit-packed
+    ([U, PB] uint8 each) for cache population — one extra small
+    transfer instead of a second dispatch."""
+    out = eval_waf_tiered.__wrapped__(
+        model, tiers, numvals, max_phase=max_phase, masks=masks, cached=cached
     )
+    packed = _pack_verdicts(out)
+    if cached is None:
+        return packed
+    hits_packed = tuple(
+        jnp.packbits(h.astype(jnp.uint8), axis=1) for h in out["_tier_hits"]
+    )
+    return packed, hits_packed
 
 
 def unpack_compact(packed: np.ndarray, n_rules: int, n_counters: int):

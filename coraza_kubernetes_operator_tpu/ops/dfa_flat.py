@@ -1,0 +1,566 @@
+"""Flat-slot fused multi-bank DFA scan — bank fusion for the matcher tier.
+
+Round-4 profiling (BASELINE.md) attributed ~96% of the CRS-scale device
+step to 19 matcher stages whose cost is per-stage fixed work, not FLOPs:
+every DFA bank was its own scan, small banks padded their group axis to
+128 lanes, banks with S > 128 states fell to XLA's serializing gather,
+and the hot S=104 x G=84 bank exceeded the per-bank Pallas VMEM budget
+and ran the HBM take-scan (one [B, S*G] HBM intermediate per byte).
+
+This module fuses MANY heterogeneous-S banks into ONE scan by
+flattening every (group, local state) pair into one slot axis:
+
+- slot n holds group ``g(n)``'s local state ``n - base_g``;
+- the machine state is a one-hot over slots (``sigma`` [B, N]);
+- one byte step is three MXU matmuls + VPU elementwise:
+    r      = onehot(byte) @ table       # [B, N] packed next + S*emit
+    val    = (sigma * r) @ sel          # [N, G] 0/1 -> per-group value
+    hit    = val >= S_g ; nxt = val - S_g*hit
+    tb     = target @ bcast             # [G, N] 0/1 -> spread over slots
+    sigma' = (tb == slot_iota)          # re-one-hot
+- no per-bank lane padding: a 7-group bank costs its ~400 slots, not
+  7 x 128 padded columns.
+
+Banks are greedily binned under the Pallas VMEM budget (big-G banks are
+split by group ranges — groups are independent, so any split is sound);
+each bin runs as ONE Pallas kernel on TPU (``_flat_kernel``) or one XLA
+``lax.scan`` with identical math elsewhere (``scan_flat_xla``).
+
+Numerics: table values are ``next + S*emit`` < 2*S — segments with
+2*S <= 256 store bf16 (integers <= 256 are bf16-exact), larger S stores
+f32 (exact < 2^24). Slot-index arithmetic (targets up to N) is f32.
+One-hot/select operands are 0/1, exact in every dtype used.
+
+Padding: each table segment's slot count and the group axis are padded
+to lane multiples (128). Dead slots carry all-zero table columns, zero
+``sel``/``bcast``/``init_sigma`` — their sigma can never become 1
+(``tb`` is 0 there while ``slot_iota`` >= 1; slot 0 is always real).
+Dead groups carry ``S_g`` = 2^30 (hit impossible) and zero map columns.
+
+Reference parity: same matcher contract as ``ops/dfa.py:scan_dfa_bank``
+(matched[b, g] == "group g's regex matched row b"), re-planned for the
+TPU's preference for one big fused kernel over many small sequential
+ones. Differential tests pin it to the gather oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.re_dfa import DFA
+
+_LANE = 128
+# Per-kernel VMEM ceiling. The per-bank kernel's history (ops/dfa.py):
+# 11MB is hardware-validated, 40MB faulted devices inside the full serve
+# loops. The flat kernel's working set is leaner (no per-bank lane
+# padding), so the budget is env-tunable for hardware validation runs —
+# raise ONLY after exercising the full serve loop on a real chip.
+import os as _os
+
+_FLAT_VMEM_BUDGET = int(_os.environ.get("CKO_FLAT_VMEM_MB", "11")) * 2**20
+_BLOCK_B = 128
+_DEAD_S = float(2**30)  # pad-group state count: hit threshold never reached
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class FlatBank:
+    """One fused scan bin: N slots over G groups, table segmented by
+    (pipeline, dtype-class) runs along the slot axis."""
+
+    tables: tuple  # per segment: [256, N_seg] bf16 or f32 (N_seg % 128 == 0)
+    sel: jnp.ndarray  # [N, Gp] bf16 0/1: slot -> its group column
+    bcast: jnp.ndarray  # [Gp, N] bf16 0/1: group -> its slots
+    init_sigma: jnp.ndarray  # [1, N] f32: one-hot of each group's state 0
+    mend: jnp.ndarray  # [1, N] f32: 1 when the slot's state is match_end
+    base_g: jnp.ndarray  # [1, Gp] f32 slot base per group
+    s_g: jnp.ndarray  # [1, Gp] f32 state count per group (hit threshold)
+    always: jnp.ndarray  # [G] bool (unpadded)
+    # static
+    seg_pipes: tuple = ()  # pipeline id per table segment
+    seg_slots: tuple = ()  # padded slot count per table segment
+    group_pipe: tuple = ()  # pipeline id per (real) group
+    pieces: tuple = ()  # (block_index, g_lo, g_hi) per covered group run
+
+    def tree_flatten(self):
+        leaves = (
+            self.tables,
+            self.sel,
+            self.bcast,
+            self.init_sigma,
+            self.mend,
+            self.base_g,
+            self.s_g,
+            self.always,
+        )
+        aux = (self.seg_pipes, self.seg_slots, self.group_pipe, self.pieces)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, *aux)
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.sel.shape[0])
+
+    @property
+    def n_groups_padded(self) -> int:
+        return int(self.sel.shape[1])
+
+    @property
+    def n_groups(self) -> int:
+        return int(self.always.shape[0])
+
+
+def flat_vmem_bytes(n_slots: int, n_groups: int, table_bytes: int, length: int) -> int:
+    """Conservative resident-set estimate for one fused kernel."""
+    n = _round_up(max(1, n_slots), _LANE)
+    g = _round_up(max(1, n_groups), _LANE)
+    consts = table_bytes + n * g * 2 * 2 + 4 * 4 * n + 4 * 4 * g
+    # per-step [B, N] live tensors: sigma/r/masked/tb/compare — bound by
+    # ~3 f32 + 2 bf16 materialized at once, double-buffer margin 2x.
+    work = _BLOCK_B * n * (3 * 4 + 2 * 2) * 2
+    work_g = _BLOCK_B * g * 4 * 6
+    data_tile = length * _BLOCK_B * 4 * 2
+    return consts + work + work_g + data_tile
+
+
+def _dfa_table_bytes(d: DFA) -> int:
+    return 256 * _round_up(d.n_states, 1) * (2 if 2 * d.n_states <= 256 else 4)
+
+
+_PALLAS_MAX_LEN = 512  # beyond this buffer width the kernel's dataT tile
+# no longer fits the planned budget (plan length_hint below); longer
+# tiers run the XLA formulation — they carry few rows (the body tier is
+# ~128 rows), so grid parallelism is nil there anyway and the [B, N]
+# per-step HBM traffic is small.
+
+
+def plan_flat_bins(
+    bank_dfas: list[tuple[int, int, list[DFA]]],
+    max_slots: int = 6144,
+    budget: int = _FLAT_VMEM_BUDGET,
+    length_hint: int = _PALLAS_MAX_LEN,
+) -> tuple[list[list[tuple[int, int, int, int, list[DFA]]]], set[int]]:
+    """Greedy bin-packing of (block_index, pipeline, dfas) banks into
+    fused-kernel bins; oversized banks split by group ranges. Returns
+    (bins, rejected_blocks): bins of (block_index, pid, g_lo, g_hi,
+    dfas-slice) pieces, plus block indexes whose single-DFA working set
+    exceeds the budget (those banks stay on the legacy scan path).
+
+    Packing is per pipeline, in block order: kind-partition masks tend
+    to exclude whole pipelines, so a mask usually skips or keeps a whole
+    bin, and stitching stays order-simple."""
+    rejected: set[int] = set()
+    for block_idx, pid, dfas in bank_dfas:
+        for d in dfas:
+            if (
+                flat_vmem_bytes(d.n_states, 1, _dfa_table_bytes(d), length_hint)
+                > budget
+            ):
+                rejected.add(block_idx)
+                break
+
+    def fits(slots: int, groups: int, tbytes: int) -> bool:
+        return (
+            slots <= max_slots
+            and flat_vmem_bytes(slots, groups, tbytes, length_hint) <= budget
+        )
+
+    pieces: list[tuple[int, int, int, int, list[DFA]]] = []
+    for block_idx, pid, dfas in bank_dfas:
+        if block_idx in rejected:
+            continue
+        start = 0
+        cur: list[DFA] = []
+        slots = 0
+        tbytes = 0
+        for gi, d in enumerate(dfas):
+            s = d.n_states
+            tb = _dfa_table_bytes(d)
+            if cur and not fits(slots + s, gi - start + 1, tbytes + tb):
+                pieces.append((block_idx, pid, start, gi, cur))
+                start, cur, slots, tbytes = gi, [], 0, 0
+            cur.append(d)
+            slots += s
+            tbytes += tb
+        if cur:
+            pieces.append((block_idx, pid, start, start + len(cur), cur))
+
+    bins: list[list[tuple[int, int, int, int, list[DFA]]]] = []
+    by_pid: dict[int, list] = {}
+    for p in pieces:
+        by_pid.setdefault(p[1], []).append(p)
+    for pid in sorted(by_pid):
+        cur_bin: list = []
+        slots = 0
+        tbytes = 0
+        groups = 0
+        for p in by_pid[pid]:
+            p_slots = sum(d.n_states for d in p[4])
+            p_tbytes = sum(_dfa_table_bytes(d) for d in p[4])
+            if cur_bin and (
+                slots + p_slots > max_slots
+                or flat_vmem_bytes(
+                    slots + p_slots,
+                    groups + len(p[4]),
+                    tbytes + p_tbytes,
+                    length_hint,
+                )
+                > budget
+            ):
+                bins.append(cur_bin)
+                cur_bin, slots, tbytes, groups = [], 0, 0, 0
+            cur_bin.append(p)
+            slots += p_slots
+            tbytes += p_tbytes
+            groups += len(p[4])
+        if cur_bin:
+            bins.append(cur_bin)
+
+    # Second pass: merge small bins ACROSS pipelines (the kernel takes
+    # one dataT per pipeline) while the union fits — every bin is a
+    # sequential kernel launch, and a 128-slot singleton costs nearly as
+    # much wall time as a 2048-slot bin. Greedy smallest-first.
+    def bin_stats(bn):
+        s = sum(d.n_states for _, _, _, _, ds in bn for d in ds)
+        g = sum(len(ds) for _, _, _, _, ds in bn)
+        t = sum(_dfa_table_bytes(d) for _, _, _, _, ds in bn for d in ds)
+        return s, g, t
+
+    bins.sort(key=lambda bn: bin_stats(bn)[0])
+    merged: list[list] = []
+    for bn in bins:
+        s, g, t = bin_stats(bn)
+        placed = False
+        for mb in merged:
+            ms, mg, mt = bin_stats(mb)
+            if ms + s <= max_slots and (
+                flat_vmem_bytes(ms + s, mg + g, mt + t, length_hint) <= budget
+            ):
+                mb.extend(bn)
+                placed = True
+                break
+        if not placed:
+            merged.append(list(bn))
+    return merged, rejected
+
+
+def build_flat_bank(bin_pieces: list[tuple[int, int, int, int, list[DFA]]]) -> FlatBank:
+    """Lay one bin out as device arrays (host-side numpy)."""
+    entries: list[tuple[DFA, int]] = []  # (dfa, pid) in slot/group order
+    pieces_static = []
+    for block_idx, pid, g_lo, g_hi, ds in bin_pieces:
+        pieces_static.append((block_idx, g_lo, g_hi))
+        for d in ds:
+            entries.append((d, pid))
+
+    # Segment runs: consecutive entries sharing (pid, bf16-class).
+    def klass(d: DFA) -> bool:
+        return 2 * d.n_states <= 256
+
+    runs: list[tuple[int, bool, list[DFA]]] = []
+    for d, pid in entries:
+        kc = klass(d)
+        if runs and runs[-1][0] == pid and runs[-1][1] == kc:
+            runs[-1][2].append(d)
+        else:
+            runs.append((pid, kc, [d]))
+
+    g_total = len(entries)
+    gp_total = _round_up(g_total, _LANE)
+    n_total = sum(_round_up(sum(d.n_states for d in ds), _LANE) for _, _, ds in runs)
+
+    sel = np.zeros((n_total, gp_total), dtype=np.float32)
+    init_sigma = np.zeros((1, n_total), dtype=np.float32)
+    mend = np.zeros((1, n_total), dtype=np.float32)
+    base_g = np.zeros((1, gp_total), dtype=np.float32)
+    s_g = np.full((1, gp_total), _DEAD_S, dtype=np.float32)
+    always = np.zeros(g_total, dtype=bool)
+    group_pipe: list[int] = []
+
+    tables: list[jnp.ndarray] = []
+    seg_pipes: list[int] = []
+    seg_slots: list[int] = []
+    off = 0
+    gi = 0
+    for pid, kc, ds in runs:
+        seg_n_raw = sum(d.n_states for d in ds)
+        seg_n = _round_up(seg_n_raw, _LANE)
+        tab = np.zeros((256, seg_n), dtype=np.float32)
+        seg_off = 0
+        for d in ds:
+            s = d.n_states
+            tab[:, seg_off : seg_off + s] = (
+                d.trans[:, d.classmap] + s * d.emit[:, d.classmap].astype(np.int64)
+            ).T
+            a = off + seg_off
+            sel[a : a + s, gi] = 1.0
+            init_sigma[0, a] = 1.0
+            mend[0, a : a + s] = d.match_end.astype(np.float32)
+            base_g[0, gi] = a
+            s_g[0, gi] = s
+            always[gi] = d.always_match
+            group_pipe.append(pid)
+            gi += 1
+            seg_off += s
+        tj = jnp.asarray(tab)
+        if kc:
+            tj = tj.astype(jnp.bfloat16)
+        tables.append(tj)
+        seg_pipes.append(pid)
+        seg_slots.append(seg_n)
+        off += seg_n
+
+    return FlatBank(
+        tables=tuple(tables),
+        sel=jnp.asarray(sel).astype(jnp.bfloat16),
+        bcast=jnp.asarray(sel.T).astype(jnp.bfloat16),
+        init_sigma=jnp.asarray(init_sigma),
+        mend=jnp.asarray(mend),
+        base_g=jnp.asarray(base_g),
+        s_g=jnp.asarray(s_g),
+        always=jnp.asarray(always),
+        seg_pipes=tuple(seg_pipes),
+        seg_slots=tuple(seg_slots),
+        group_pipe=tuple(group_pipe),
+        pieces=tuple(pieces_static),
+    )
+
+
+def _flat_step_math(sigma, matched, r, active_g, sel_f32, bcast_f32, base_g, s_g, slot_iota):
+    """Shared per-byte math (Pallas kernel body and XLA fallback).
+
+    sigma [B, N] f32 one-hot; matched [B, Gp] f32; r [B, N] f32 packed
+    values for this byte; active_g [B, Gp] f32 0/1. All matmuls f32 with
+    f32 accumulation — every product term is exact (< 2^24) and at most
+    one term per output is nonzero for the select/spread contractions."""
+    masked = sigma * r  # [B, N]
+    val = jnp.dot(masked, sel_f32, preferred_element_type=jnp.float32)  # [B, Gp]
+    hit = (val >= s_g).astype(jnp.float32)
+    nxt = val - s_g * hit
+    matched = jnp.maximum(matched, hit * active_g)
+    cur_abs = jnp.dot(
+        sigma * slot_iota, sel_f32, preferred_element_type=jnp.float32
+    )  # [B, Gp] absolute slot of the current state
+    target = active_g * (base_g + nxt) + (1.0 - active_g) * cur_abs
+    tb = jnp.dot(target, bcast_f32, preferred_element_type=jnp.float32)  # [B, N]
+    sigma = (tb == slot_iota).astype(jnp.float32)
+    return sigma, matched
+
+
+def _group_pipe_onehot(flat: FlatBank, pids: list[int]) -> np.ndarray:
+    """[P, Gp] f32: group -> owning pipeline (pad groups all-zero)."""
+    gp = np.zeros((len(pids), flat.n_groups_padded), dtype=np.float32)
+    pid_ix = {p: i for i, p in enumerate(pids)}
+    for gi, pid in enumerate(flat.group_pipe):
+        gp[pid_ix[pid], gi] = 1.0
+    return gp
+
+
+def scan_flat_xla(
+    flat: FlatBank, data_by_pipe: dict[int, tuple[jnp.ndarray, jnp.ndarray]]
+) -> jnp.ndarray:
+    """XLA lax.scan formulation — the CPU path and the semantic twin of
+    the Pallas kernel (same ``_flat_step_math``)."""
+    pids = sorted(set(flat.seg_pipes))
+    d0 = data_by_pipe[pids[0]][0]
+    b = d0.shape[0]
+    n, gp_n = flat.n_slots, flat.n_groups_padded
+    slot_iota = jnp.arange(n, dtype=jnp.float32)[None, :]
+
+    dataT = jnp.stack(
+        [data_by_pipe[p][0].T for p in pids], axis=1
+    ).astype(jnp.int32)  # [L, P, B]
+    lens = jnp.stack([data_by_pipe[p][1] for p in pids], axis=0)  # [P, B]
+    pid_ix = {p: i for i, p in enumerate(pids)}
+    gp_j = jnp.asarray(_group_pipe_onehot(flat, pids))
+    sel_f32 = flat.sel.astype(jnp.float32)
+    bcast_f32 = flat.bcast.astype(jnp.float32)
+
+    row0 = dataT[0, 0, :, None].astype(jnp.float32) * 0  # [B, 1] varying zero
+    sigma0 = jnp.broadcast_to(flat.init_sigma, (b, n)).astype(jnp.float32) + row0
+    matched0 = jnp.zeros((b, gp_n), dtype=jnp.float32) + row0
+
+    def step(carry, xs):
+        sigma, matched = carry
+        t, byte_cols = xs  # byte_cols [P, B]
+        rs = [
+            jnp.take(tab, byte_cols[pid_ix[p]], axis=0).astype(jnp.float32)
+            for tab, p in zip(flat.tables, flat.seg_pipes)
+        ]
+        r = jnp.concatenate(rs, axis=1)  # [B, N]
+        active_p = (t < lens).astype(jnp.float32)  # [P, B]
+        active_g = jnp.dot(active_p.T, gp_j)  # [B, Gp]
+        sigma, matched = _flat_step_math(
+            sigma, matched, r, active_g, sel_f32, bcast_f32,
+            flat.base_g, flat.s_g, slot_iota,
+        )
+        return (sigma, matched), None
+
+    ts = jnp.arange(dataT.shape[0], dtype=jnp.int32)
+    (sigma, matched), _ = jax.lax.scan(step, (sigma0, matched0), (ts, dataT))
+    end_hit = jnp.dot(sigma * flat.mend, sel_f32, preferred_element_type=jnp.float32)
+    out = (matched + end_hit) > 0
+    return out[:, : flat.n_groups] | flat.always[None, :]
+
+
+def _flat_kernel(*refs, seg_pipes, seg_slots, pid_ix, n, gp_n, length, n_pipes):
+    """Pallas kernel: one [Bt] row-block over all bytes, all banks fused.
+
+    refs: dataT_p x P ([L, Bt]), len_p x P ([Bt, 1]), tables per segment,
+    sel [N, Gp], bcast [Gp, N], init_sigma [1, N], mend [1, N],
+    base_g [1, Gp], s_g [1, Gp], gp [P, Gp], out [Bt, Gp]."""
+    it = iter(refs)
+    dataT = [next(it) for _ in range(n_pipes)]
+    lens = [next(it) for _ in range(n_pipes)]
+    tables = [next(it) for _ in range(len(seg_slots))]
+    sel_ref = next(it)
+    bcast_ref = next(it)
+    init_ref = next(it)
+    mend_ref = next(it)
+    base_ref = next(it)
+    sg_ref = next(it)
+    gp_ref = next(it)
+    out_ref = next(it)
+
+    bt = out_ref.shape[0]
+    # Mosaic's tpu.iota is integer-only; cast after.
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1).astype(jnp.float32)
+    bytes_iota = jax.lax.broadcasted_iota(jnp.int32, (bt, 256), 1)
+    sel_f32 = sel_ref[:].astype(jnp.float32)
+    bcast_f32 = bcast_ref[:].astype(jnp.float32)
+    base_g = base_ref[:]
+    s_g = sg_ref[:]
+    gp = gp_ref[:]  # [P, Gp]
+
+    def step(t, carry):
+        sigma, matched = carry
+        onehots = {}
+        rs = []
+        for si, seg_pid in enumerate(seg_pipes):
+            p = pid_ix[seg_pid]
+            if p not in onehots:
+                byte = dataT[p][t, :][:, None]  # [Bt, 1]
+                onehots[p] = byte == bytes_iota
+            tab = tables[si][:]
+            oh = onehots[p].astype(tab.dtype)
+            rs.append(jnp.dot(oh, tab, preferred_element_type=jnp.float32))
+        r = jnp.concatenate(rs, axis=1)  # [Bt, N]
+        active_p = jnp.concatenate(
+            [
+                (t < lens[i][:, 0][:, None]).astype(jnp.float32)
+                for i in range(n_pipes)
+            ],
+            axis=1,
+        )  # [Bt, P]
+        active_g = jnp.dot(active_p, gp, preferred_element_type=jnp.float32)
+        return _flat_step_math(
+            sigma, matched, r, active_g, sel_f32, bcast_f32, base_g, s_g, slot_iota
+        )
+
+    sigma0 = jnp.broadcast_to(init_ref[:], (bt, n))
+    matched0 = jnp.zeros((bt, gp_n), dtype=jnp.float32)
+    sigma, matched = jax.lax.fori_loop(0, length, step, (sigma0, matched0))
+    end_hit = jnp.dot(sigma * mend_ref[:], sel_f32, preferred_element_type=jnp.float32)
+    out_ref[:] = ((matched + end_hit) > 0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _scan_flat_pallas(flat: FlatBank, dataT_list, lens_list, gp, interpret=False):
+    from jax.experimental import pallas as pl
+
+    n, gp_n = flat.n_slots, flat.n_groups_padded
+    length, bp = dataT_list[0].shape
+    n_pipes = len(dataT_list)
+    pids = sorted(set(flat.seg_pipes))
+    pid_ix = {p: i for i, p in enumerate(pids)}
+
+    kernel = functools.partial(
+        _flat_kernel,
+        seg_pipes=flat.seg_pipes,
+        seg_slots=flat.seg_slots,
+        pid_ix=pid_ix,
+        n=n,
+        gp_n=gp_n,
+        length=length,
+        n_pipes=n_pipes,
+    )
+    in_specs = (
+        [pl.BlockSpec((length, _BLOCK_B), lambda i: (0, i)) for _ in range(n_pipes)]
+        + [pl.BlockSpec((_BLOCK_B, 1), lambda i: (i, 0)) for _ in range(n_pipes)]
+        + [pl.BlockSpec((256, sn), lambda i: (0, 0)) for sn in flat.seg_slots]
+        + [
+            pl.BlockSpec((n, gp_n), lambda i: (0, 0)),
+            pl.BlockSpec((gp_n, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, gp_n), lambda i: (0, 0)),
+            pl.BlockSpec((1, gp_n), lambda i: (0, 0)),
+            pl.BlockSpec((n_pipes, gp_n), lambda i: (0, 0)),
+        ]
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bp // _BLOCK_B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((_BLOCK_B, gp_n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, gp_n), jnp.int32),
+        interpret=interpret,
+    )(
+        *dataT_list,
+        *lens_list,
+        *flat.tables,
+        flat.sel,
+        flat.bcast,
+        flat.init_sigma,
+        flat.mend,
+        flat.base_g,
+        flat.s_g,
+        gp,
+    )
+
+
+def scan_flat_bank(
+    flat: FlatBank,
+    data_by_pipe: dict[int, tuple[jnp.ndarray, jnp.ndarray]],
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Fused scan of one bin. Returns matched [B, G_bin] bool.
+
+    Pallas kernel on TPU; XLA scan elsewhere. ``interpret=True`` forces
+    the kernel through the Pallas interpreter (CPU kernel-logic tests).
+    Buffers wider than _PALLAS_MAX_LEN (the width the bins' VMEM plan
+    budgeted for) stream through the XLA formulation instead."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return scan_flat_xla(flat, data_by_pipe)
+        pids_chk = sorted(set(flat.seg_pipes))
+        if data_by_pipe[pids_chk[0]][0].shape[1] > _PALLAS_MAX_LEN:
+            return scan_flat_xla(flat, data_by_pipe)
+        interpret = False
+
+    pids = sorted(set(flat.seg_pipes))
+    d0 = data_by_pipe[pids[0]][0]
+    b = d0.shape[0]
+    bp = _round_up(max(b, _BLOCK_B), _BLOCK_B)
+    dataT_list, lens_list = [], []
+    for p in pids:
+        d, ln = data_by_pipe[p]
+        dataT_list.append(jnp.pad(d.astype(jnp.int32), ((0, bp - b), (0, 0))).T)
+        lens_list.append(jnp.pad(ln.astype(jnp.int32), (0, bp - b))[:, None])
+    gp = jnp.asarray(_group_pipe_onehot(flat, pids))
+    out = _scan_flat_pallas(
+        flat, tuple(dataT_list), tuple(lens_list), gp, interpret=interpret
+    )
+    return (out[:b, : flat.n_groups] != 0) | flat.always[None, :]

@@ -77,6 +77,12 @@ class SidecarConfig:
     # budget instead of request_timeout_s; after warmup the strict
     # request timeout applies.
     compile_timeout_s: float = 600.0
+    # Warmed engines can still hit a fresh-shape recompile mid-stream (a
+    # first long-body request mints a new tier bucket). While the batcher
+    # is actively evaluating, waits extend past request_timeout_s by at
+    # most this grace — bounded so a wedged device step fails requests in
+    # timeout+grace, not compile_timeout_s.
+    recompile_grace_s: float = 120.0
     # Audit log: None disables, "-" is stdout (the reference data plane's
     # SecAuditLog /dev/stdout shape), anything else a file path.
     audit_log: str | None = None
@@ -507,9 +513,15 @@ class TpuEngineSidecar:
         import time as _time
         from concurrent.futures import TimeoutError as _FutTimeout
 
-        deadline_max = _time.monotonic() + max(
-            self.config.compile_timeout_s, timeout
-        )
+        # Cold engines get the full compile budget. Warmed engines keep a
+        # meaningful SLA: the strict timeout plus a bounded recompile
+        # grace (fresh-shape recompiles mid-stream are real, but a wedged
+        # device step must fail clients in timeout+grace, not 600s).
+        if timeout > self.config.request_timeout_s:  # some engine is cold
+            hard_budget = timeout
+        else:
+            hard_budget = timeout + max(0.0, self.config.recompile_grace_s)
+        deadline_max = _time.monotonic() + hard_budget
         out: list[Verdict] = []
         for f in futures:
             while True:
@@ -526,9 +538,10 @@ class TpuEngineSidecar:
                     if remaining <= 0:
                         raise
                     # A device step (possibly a fresh-shape recompile) is
-                    # in flight: extend rather than fail mid-compile —
-                    # bounded by compile_timeout_s total.
-                    if self.batcher.busy or self.batcher.pending():
+                    # in flight: extend rather than fail mid-compile.
+                    # Only `busy` extends — a deep queue behind a healthy
+                    # batcher is not a reason to waive OUR deadline.
+                    if self.batcher.busy:
                         continue
                     # Grace re-check: busy is briefly False between
                     # windows while a request moves queue->window.

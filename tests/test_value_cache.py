@@ -1,0 +1,97 @@
+"""Cross-batch value-hit cache: differential equivalence + accounting.
+
+The cache must be invisible to verdicts: any sequence of batches served
+through a cache-enabled engine yields exactly the verdicts of a
+cache-disabled engine, while repeated values skip the matcher (hit rate
+climbs) and the byte budget bounds residency.
+"""
+
+import numpy as np
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.engine.value_cache import ValueHitCache
+
+RULES = r"""
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,pass"
+SecAction "id:900100,phase:1,nolog,pass,setvar:tx.score=0"
+SecRule ARGS|REQUEST_URI "@rx (?i)union\s+select" "id:7001,phase:2,pass,setvar:tx.score=+5"
+SecRule REQUEST_HEADERS:User-Agent "@contains sqlmap" "id:7002,phase:1,pass,setvar:tx.score=+5,t:lowercase"
+SecRule ARGS "@contains ../" "id:7003,phase:2,deny,status:403"
+SecRule TX:score "@ge 5" "id:7999,phase:2,deny,status:406"
+"""
+
+
+def _traffic(seed, n=48):
+    import random
+
+    rng = random.Random(seed)
+    uas = ["curl/8.0", "Mozilla/5.0", "sqlmap/1.7", "Go-http-client/1.1"]
+    reqs = []
+    for i in range(n):
+        roll = rng.random()
+        if roll < 0.2:
+            uri = f"/search?q=1+UNION+SELECT+x{rng.randrange(100)}"
+        elif roll < 0.3:
+            uri = f"/files?p=../../etc/passwd&s={rng.randrange(100):x}"
+        else:
+            uri = f"/item/{rng.randrange(40)}?v={rng.randrange(50)}"
+        reqs.append(
+            HttpRequest(
+                method="GET",
+                uri=uri,
+                headers=[("Host", "shop.example"), ("User-Agent", rng.choice(uas))],
+            )
+        )
+    return reqs
+
+
+def _tuples(vs):
+    return [
+        (v.interrupted, v.status, v.rule_id, tuple(v.matched_ids), tuple(sorted(v.scores.items())))
+        for v in vs
+    ]
+
+
+def test_cache_invisible_to_verdicts(monkeypatch):
+    cached_engine = WafEngine(RULES)
+    assert cached_engine.value_cache is not None
+    plain = WafEngine(RULES)
+    plain.value_cache = None
+
+    for seed in (1, 2, 1, 3, 2):  # repeats exercise warm-cache batches
+        reqs = _traffic(seed)
+        got = _tuples(cached_engine.evaluate(reqs))
+        want = _tuples(plain.evaluate(reqs))
+        assert got == want, f"seed {seed}"
+
+    st = cached_engine.value_cache.stats()
+    assert st["hits"] > 0, st  # repeated batches actually hit
+    assert st["entries"] > 0
+    # An identical replay must be (nearly) all hits.
+    before = cached_engine.value_cache.stats()["misses"]
+    got = _tuples(cached_engine.evaluate(_traffic(1)))
+    assert got == _tuples(plain.evaluate(_traffic(1)))
+    assert cached_engine.value_cache.stats()["misses"] == before
+
+
+def test_cache_eviction_respects_budget():
+    c = ValueHitCache(packed_len=8, max_bytes=4096)
+    rows = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    for batch in range(40):
+        keys = [f"key-{batch}-{i}".encode() * 3 for i in range(8)]
+        c.insert(keys, rows)
+    st = c.stats()
+    assert st["evictions"] > 0
+    assert st["bytes"] <= 4096
+
+
+def test_cache_lru_recency():
+    c = ValueHitCache(packed_len=1, max_bytes=10_000_000)
+    c.insert([b"a", b"b"], np.zeros((2, 1), np.uint8))
+    found, miss = c.lookup([b"a", b"c"])
+    assert list(found) == [0] and miss == [1]
+    st = c.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
