@@ -159,13 +159,26 @@ class _ProbeHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         ready_fn = self.server.ready_fn  # type: ignore[attr-defined]
         metrics = self.server.metrics  # type: ignore[attr-defined]
+        token = getattr(self.server, "auth_token", None)
         if path == "/healthz":
             body, code = b"ok\n", 200
         elif path == "/readyz":
             ok = ready_fn()
             body, code = (b"ok\n", 200) if ok else (b"not ready\n", 503)
         elif path == "/metrics" and metrics is not None:
-            body, code = metrics.render().encode(), 200
+            # Authn/authz parity with the reference's protected metrics
+            # endpoint (cmd/main.go:123-177 FilterProvider WithAuthentication
+            # AndAuthorization): no cluster TokenReview exists here, so the
+            # analog is a static bearer token.
+            import hmac
+
+            presented = self.headers.get("Authorization") or ""
+            if token and not hmac.compare_digest(
+                presented.encode(), f"Bearer {token}".encode()
+            ):
+                body, code = b"unauthorized\n", 401
+            else:
+                body, code = metrics.render().encode(), 200
         else:
             body, code = b"not found\n", 404
         self.send_response(code)
@@ -175,11 +188,82 @@ class _ProbeHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
-def _serve(addr: str, ready_fn, metrics=None) -> ThreadingHTTPServer:
+def _self_signed_cert() -> tuple[str, str]:
+    """Generate an in-memory self-signed cert (kubebuilder's default when
+    --metrics-secure is on and no cert dir is provided); returns
+    (certfile, keyfile) temp paths."""
+    import datetime
+    import tempfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "cko-operator-metrics")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cf = tempfile.NamedTemporaryFile(suffix=".crt", delete=False)
+    cf.write(cert.public_bytes(serialization.Encoding.PEM))
+    cf.close()
+    kf = tempfile.NamedTemporaryFile(suffix=".key", delete=False)
+    kf.write(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    kf.close()
+    return cf.name, kf.name
+
+
+def _serve(
+    addr: str,
+    ready_fn,
+    metrics=None,
+    secure: bool = False,
+    certfile: str | None = None,
+    keyfile: str | None = None,
+    auth_token: str | None = None,
+) -> ThreadingHTTPServer:
+    import ssl
+
     host, _, port = addr.rpartition(":")
     srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _ProbeHandler)
     srv.ready_fn = ready_fn  # type: ignore[attr-defined]
     srv.metrics = metrics  # type: ignore[attr-defined]
+    srv.auth_token = auth_token  # type: ignore[attr-defined]
+    if secure:
+        if bool(certfile) != bool(keyfile):
+            raise SystemExit(
+                "metrics TLS: provide BOTH --metrics-cert-path and "
+                "--metrics-cert-key, or neither (self-signed)"
+            )
+        if not certfile:
+            certfile, keyfile = _self_signed_cert()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        # HTTP/2 stays off (reference: disableHTTP2 default true —
+        # HTTP/2 rapid-reset mitigations, cmd/main.go); h2 would need an
+        # explicit ALPN offer, which is simply never made.
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
 
@@ -199,6 +283,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--metrics-bind-address", default="",
                    help="empty disables the metrics endpoint (reference default)")
+    p.add_argument("--metrics-secure", default=True,
+                   type=lambda v: v.lower() not in ("false", "0", "no"),
+                   help="serve metrics over HTTPS with bearer authn "
+                        "(reference cmd/main.go --metrics-secure default); "
+                        "pass false for plaintext")
+    p.add_argument("--metrics-cert-path", default="",
+                   help="TLS cert for the metrics endpoint; a self-signed "
+                        "pair is generated when omitted (kubebuilder parity)")
+    p.add_argument("--metrics-cert-key", default="")
+    p.add_argument("--metrics-auth-token-file", default="",
+                   help="file holding the static bearer token metrics "
+                        "clients must present (the no-cluster analog of "
+                        "TokenReview authn); generated when omitted")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--kubeconfig", default="",
                    help="kubeconfig path; auto-detects $KUBECONFIG / in-cluster "
@@ -265,8 +362,29 @@ def main(argv: list[str] | None = None, stop: threading.Event | None = None) -> 
     probe_srv = _serve(args.health_probe_bind_address, ready.is_set)
     metrics_srv = None
     if args.metrics_bind_address:
+        token = None
+        if args.metrics_secure:
+            if args.metrics_auth_token_file:
+                token = Path(args.metrics_auth_token_file).read_text().strip()
+            else:
+                import secrets
+                import tempfile
+
+                token = secrets.token_urlsafe(32)
+                tf = tempfile.NamedTemporaryFile(
+                    "w", suffix=".metrics-token", delete=False
+                )
+                tf.write(token)
+                tf.close()
+                log.info("generated metrics bearer token", path=tf.name)
         metrics_srv = _serve(
-            args.metrics_bind_address, ready.is_set, cache_server.metrics
+            args.metrics_bind_address,
+            ready.is_set,
+            cache_server.metrics,
+            secure=args.metrics_secure,
+            certfile=args.metrics_cert_path or None,
+            keyfile=args.metrics_cert_key or None,
+            auth_token=token,
         )
 
     if stop is None:

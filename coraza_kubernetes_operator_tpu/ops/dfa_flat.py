@@ -55,14 +55,15 @@ import numpy as np
 from ..compiler.re_dfa import DFA
 
 _LANE = 128
-# Per-kernel VMEM ceiling. The per-bank kernel's history (ops/dfa.py):
-# 11MB is hardware-validated, 40MB faulted devices inside the full serve
-# loops. The flat kernel's working set is leaner (no per-bank lane
-# padding), so the budget is env-tunable for hardware validation runs —
-# raise ONLY after exercising the full serve loop on a real chip.
+# Per-kernel VMEM ceiling. The chip enforces a 16MB scoped-vmem limit at
+# COMPILE time (observed: a 3584-slot bin at L=2048 rejected at
+# 16.09M/16.00M with a clean remote-compile error — not the round-4
+# style runtime fault). The estimator below is calibrated against that
+# measurement; the default budget keeps ~1MB of margin under the real
+# limit. Env-tunable for validation runs.
 import os as _os
 
-_FLAT_VMEM_BUDGET = int(_os.environ.get("CKO_FLAT_VMEM_MB", "11")) * 2**20
+_FLAT_VMEM_BUDGET = int(_os.environ.get("CKO_FLAT_VMEM_MB", "15")) * 2**20
 _BLOCK_B = 128
 _DEAD_S = float(2**30)  # pad-group state count: hit threshold never reached
 
@@ -122,16 +123,24 @@ class FlatBank:
         return int(self.always.shape[0])
 
 
-def flat_vmem_bytes(n_slots: int, n_groups: int, table_bytes: int, length: int) -> int:
-    """Conservative resident-set estimate for one fused kernel."""
+def flat_vmem_bytes(
+    n_slots: int,
+    n_groups: int,
+    table_bytes: int,
+    length: int,
+    n_pipes: int = 2,
+) -> int:
+    """Resident-set estimate for one fused kernel, CALIBRATED against
+    the chip's compile-time scope accounting: a 3584-slot 2-pipe bin at
+    L=2048 measured 16.09MB = tables(1.8) + sel/bcast(1.8) + dataT
+    tiles(4x s32[2048,128] = 4.0) + per-step work(~8.5 -> ~2370 B/slot
+    ~= 128 x N x 18.5). The work coefficient uses 20 for margin."""
     n = _round_up(max(1, n_slots), _LANE)
     g = _round_up(max(1, n_groups), _LANE)
     consts = table_bytes + n * g * 2 * 2 + 4 * 4 * n + 4 * 4 * g
-    # per-step [B, N] live tensors: sigma/r/masked/tb/compare — bound by
-    # ~3 f32 + 2 bf16 materialized at once, double-buffer margin 2x.
-    work = _BLOCK_B * n * (3 * 4 + 2 * 2) * 2
+    work = _BLOCK_B * n * 20
     work_g = _BLOCK_B * g * 4 * 6
-    data_tile = length * _BLOCK_B * 4 * 2
+    data_tile = length * _BLOCK_B * 4 * 2 * max(1, n_pipes)
     return consts + work + work_g + data_tile
 
 
@@ -139,11 +148,44 @@ def _dfa_table_bytes(d: DFA) -> int:
     return 256 * _round_up(d.n_states, 1) * (2 if 2 * d.n_states <= 256 else 4)
 
 
-_PALLAS_MAX_LEN = 512  # beyond this buffer width the kernel's dataT tile
-# no longer fits the planned budget (plan length_hint below); longer
-# tiers run the XLA formulation — they carry few rows (the body tier is
-# ~128 rows), so grid parallelism is nil there anyway and the [B, N]
-# per-step HBM traffic is small.
+def _layout_stats(pieces) -> tuple[int, int, int, int]:
+    """(padded_slots, groups, table_bytes, n_pipes) exactly as
+    ``build_flat_bank`` will lay this piece list out — every
+    (pipeline, dtype-class) run pads to a lane multiple, so the planner
+    budgets the REAL slot count, not the raw sum (review r5: the raw sum
+    underestimated interleaved small-bank bins)."""
+    total = 0
+    run_slots = 0
+    prev = None
+    groups = 0
+    tbytes = 0
+    pipes = set()
+    for _blk, pid, _lo, _hi, ds in pieces:
+        pipes.add(pid)
+        for d in ds:
+            key = (pid, 2 * d.n_states <= 256)
+            if prev is not None and key != prev and run_slots:
+                total += _round_up(run_slots, _LANE)
+                run_slots = 0
+            prev = key
+            run_slots += d.n_states
+            groups += 1
+            tbytes += _dfa_table_bytes(d)
+    total += _round_up(run_slots, _LANE)
+    return total, groups, tbytes, max(1, len(pipes))
+
+
+# Widest buffer the Pallas kernel accepts; wider tiers run the XLA
+# formulation (they carry few rows — the body tier is ~128 — so grid
+# parallelism is nil there anyway). The real ceiling is the chip's 16MB
+# scoped-vmem limit, which the REMOTE COMPILER enforces with a clean
+# compile-time error (observed: a 3584-slot bin at L=2048 rejected at
+# 16.09M/16.00M), so an over-budget combination fails visibly at
+# compile, never as a runtime fault. 2048 with the default 11MB plan
+# (bins <= ~2304 slots) is hardware-validated in the full serve loop;
+# lower CKO_FLAT_MAX_LEN if a custom ruleset's bins hit the compile
+# error on long tiers.
+_PALLAS_MAX_LEN = int(_os.environ.get("CKO_FLAT_MAX_LEN", "2048"))
 
 
 def plan_flat_bins(
@@ -165,16 +207,21 @@ def plan_flat_bins(
     for block_idx, pid, dfas in bank_dfas:
         for d in dfas:
             if (
-                flat_vmem_bytes(d.n_states, 1, _dfa_table_bytes(d), length_hint)
+                flat_vmem_bytes(
+                    _round_up(d.n_states, _LANE), 1, _dfa_table_bytes(d),
+                    length_hint, 1,
+                )
                 > budget
             ):
                 rejected.add(block_idx)
                 break
 
-    def fits(slots: int, groups: int, tbytes: int) -> bool:
+    def fits(pieces: list) -> bool:
+        slots, groups, tbytes, pipes = _layout_stats(pieces)
         return (
             slots <= max_slots
-            and flat_vmem_bytes(slots, groups, tbytes, length_hint) <= budget
+            and flat_vmem_bytes(slots, groups, tbytes, length_hint, pipes)
+            <= budget
         )
 
     pieces: list[tuple[int, int, int, int, list[DFA]]] = []
@@ -183,17 +230,11 @@ def plan_flat_bins(
             continue
         start = 0
         cur: list[DFA] = []
-        slots = 0
-        tbytes = 0
         for gi, d in enumerate(dfas):
-            s = d.n_states
-            tb = _dfa_table_bytes(d)
-            if cur and not fits(slots + s, gi - start + 1, tbytes + tb):
+            if cur and not fits([(block_idx, pid, start, gi, cur + [d])]):
                 pieces.append((block_idx, pid, start, gi, cur))
-                start, cur, slots, tbytes = gi, [], 0, 0
+                start, cur = gi, []
             cur.append(d)
-            slots += s
-            tbytes += tb
         if cur:
             pieces.append((block_idx, pid, start, start + len(cur), cur))
 
@@ -203,28 +244,11 @@ def plan_flat_bins(
         by_pid.setdefault(p[1], []).append(p)
     for pid in sorted(by_pid):
         cur_bin: list = []
-        slots = 0
-        tbytes = 0
-        groups = 0
         for p in by_pid[pid]:
-            p_slots = sum(d.n_states for d in p[4])
-            p_tbytes = sum(_dfa_table_bytes(d) for d in p[4])
-            if cur_bin and (
-                slots + p_slots > max_slots
-                or flat_vmem_bytes(
-                    slots + p_slots,
-                    groups + len(p[4]),
-                    tbytes + p_tbytes,
-                    length_hint,
-                )
-                > budget
-            ):
+            if cur_bin and not fits(cur_bin + [p]):
                 bins.append(cur_bin)
-                cur_bin, slots, tbytes, groups = [], 0, 0, 0
+                cur_bin = []
             cur_bin.append(p)
-            slots += p_slots
-            tbytes += p_tbytes
-            groups += len(p[4])
         if cur_bin:
             bins.append(cur_bin)
 
@@ -232,22 +256,12 @@ def plan_flat_bins(
     # one dataT per pipeline) while the union fits — every bin is a
     # sequential kernel launch, and a 128-slot singleton costs nearly as
     # much wall time as a 2048-slot bin. Greedy smallest-first.
-    def bin_stats(bn):
-        s = sum(d.n_states for _, _, _, _, ds in bn for d in ds)
-        g = sum(len(ds) for _, _, _, _, ds in bn)
-        t = sum(_dfa_table_bytes(d) for _, _, _, _, ds in bn for d in ds)
-        return s, g, t
-
-    bins.sort(key=lambda bn: bin_stats(bn)[0])
+    bins.sort(key=lambda bn: _layout_stats(bn)[0])
     merged: list[list] = []
     for bn in bins:
-        s, g, t = bin_stats(bn)
         placed = False
         for mb in merged:
-            ms, mg, mt = bin_stats(mb)
-            if ms + s <= max_slots and (
-                flat_vmem_bytes(ms + s, mg + g, mt + t, length_hint) <= budget
-            ):
+            if fits(mb + bn):
                 mb.extend(bn)
                 placed = True
                 break

@@ -92,6 +92,11 @@ class SidecarConfig:
     # ordering, SURVEY §3.4). Costs a second device pass per window when
     # any phase-1 rule exists; disable for single-pass throughput.
     phase_split: bool = False
+    # Bearer token required on /waf/v1/metrics when set (the serving
+    # listener is the data plane, so the metrics path is the only
+    # operator-facing surface on it — reference parity: metrics behind
+    # authn/authz, cmd/main.go:123-177).
+    metrics_auth_token: str | None = None
     # Honor X-Waf-Tenant (filter mode) and per-request/header tenant
     # selection (bulk mode). Off by default: both surfaces share the same
     # unauthenticated listener, so tenant selection from request content
@@ -187,6 +192,15 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == API_PREFIX + "stats":
             self._reply_json(200, self.sidecar.stats())
         elif path == API_PREFIX + "metrics":
+            import hmac
+
+            token = self.sidecar.config.metrics_auth_token
+            presented = self.headers.get("Authorization") or ""
+            if token and not hmac.compare_digest(
+                presented.encode(), f"Bearer {token}".encode()
+            ):
+                self._reply_json(401, {"error": "unauthorized"})
+                return
             self._reply(
                 200,
                 self.sidecar.metrics.render().encode(),
@@ -538,9 +552,12 @@ class TpuEngineSidecar:
                     if remaining <= 0:
                         raise
                     # A device step (possibly a fresh-shape recompile) is
-                    # in flight: extend rather than fail mid-compile.
-                    # Only `busy` extends — a deep queue behind a healthy
-                    # batcher is not a reason to waive OUR deadline.
+                    # in flight, or our request still sits queued behind a
+                    # live batcher: extend rather than fail mid-compile.
+                    # The extension is BOUNDED by deadline_max (strict
+                    # timeout + recompile grace for warmed engines), so a
+                    # deep queue delays at most that long, never the full
+                    # compile budget.
                     if self.batcher.busy:
                         continue
                     # Grace re-check: busy is briefly False between

