@@ -130,11 +130,13 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     p50 = statistics.median(per_chunk)
     p99 = sorted(per_chunk)[max(0, math.ceil(len(per_chunk) * 0.99) - 1)]
 
-    blocked = int(
-        jax.numpy.sum(
-            eval_waf_tiered(m, dev_tiers, dev_nv, masks=masks)["interrupted"]
-        )
-    )
+    # Blocked count read from chunk 0 of the serve dispatch: its only
+    # divergence from the unperturbed batch is byte 0 of unique-row 0
+    # set to 0 (affects at most the requests sharing that one row) — a
+    # dedicated un-mapped eval for the exact count would be another
+    # full-model compile through the axon tunnel (~15 min cold,
+    # measured blowing the warm budget).
+    blocked = int(out[0])
     return {
         "req_per_s": round(batch / best, 1),
         "p50_chunk_ms": round(p50 * 1e3, 3),
@@ -332,8 +334,14 @@ def _config_3(iters, n_chunks, n_rules):
     res["ftw_attack_stages"] = n_attacks
 
     # Cross-batch value-cache serving (round-5 lever #3): distinct
-    # batches, repeated VALUES — reported with its hit rate.
-    n_cb = int(os.environ.get("BENCH_CACHE_BATCHES", "10"))
+    # batches, repeated VALUES — reported with its hit rate. Off by
+    # default in the driver run: each batch's shrinking miss-row bucket
+    # mints fresh executables (a compile bomb through the axon tunnel,
+    # measured blowing a 3600s warm budget); the cache's serving
+    # evidence rides the e2e config instead (its bulk path exercises
+    # tier_cached and reports the hit rate). Enable via
+    # BENCH_CACHE_BATCHES for dedicated runs.
+    n_cb = int(os.environ.get("BENCH_CACHE_BATCHES", "0"))
     if n_cb > 0:
         try:
             res["cached_serving"] = _cached_serving_loop(eng, 4096, n_cb)
@@ -352,15 +360,16 @@ def _config_3(iters, n_chunks, n_rules):
     # is another full set of per-tier compiles; scan wider via env when
     # hunting an operating point, not in the driver run).
     lat_iters = int(os.environ.get("BENCH_LAT_ITERS", "100"))
-    # Three operating points by default (r5): the serving batch, a mid
-    # point, and a small batch — the <2ms p99 conjunction is only
-    # reachable (if at all) at small batches, and a scan that never
-    # probes them reports latency_compliant: null vacuously (VERDICT r4
-    # missing #4). bench.warm covers the same points, so the driver run
-    # hits warm executables.
+    # Two operating points by default (r5): the serving batch and a small
+    # batch — the <2ms p99 conjunction is only reachable (if at all) at
+    # small batches, and a scan that never probes them reports
+    # latency_compliant: null vacuously (VERDICT r4 missing #4). Every
+    # extra point is a full per-tier compile set through the axon tunnel
+    # (~10-20 min cold), so the scan stays narrow; bench.warm covers the
+    # same points so the driver run hits warm executables.
     lat_points = [
         int(b)
-        for b in os.environ.get("BENCH_LAT_POINTS", "2048,512,128").split(",")
+        for b in os.environ.get("BENCH_LAT_POINTS", "2048,128").split(",")
         if b.strip()
     ]
     best = None
@@ -463,11 +472,14 @@ def _config_e2e(iters):
     # One distinct payload per timed shot (+1 warm): the engine's
     # cross-batch value cache would otherwise serve a repeated payload
     # entirely from cache and the number would measure replay, not
-    # serving. Values still repeat across payloads (UA/Host pools,
+    # serving. Values still repeat ACROSS payloads (UA/Host pools,
     # corpus attack stages) exactly as real traffic repeats them; the
-    # observed hit rate is reported alongside.
-    n_samples = max(iters, 20)
-    n_payloads = int(os.environ.get("BENCH_E2E_PAYLOADS", str(n_samples + 1)))
+    # observed hit rate is reported alongside. Payload count bounds the
+    # sample count (never replay within the timed window) and stays
+    # small because every distinct miss-row bucket is a fresh compile
+    # through the axon tunnel.
+    n_payloads = int(os.environ.get("BENCH_E2E_PAYLOADS", "9"))
+    n_samples = n_payloads - 1  # payload 0 is the warm shot, never timed
     payloads = []
     corpus_info = None
     for i in range(n_payloads):
