@@ -472,6 +472,14 @@ def _config_e2e(iters):
         TpuEngineSidecar,
     )
 
+    # Value cache OFF in this child by default: every distinct miss-row
+    # bucket is a fresh full-model compile through the axon tunnel, and
+    # 9 rotating payloads minted enough shapes to blow a 3000s warm
+    # budget (measured). Cache-off bulk shapes stabilize after 1-2
+    # compiles. Set BENCH_E2E_CACHE=1 for a dedicated cache-on run (the
+    # cache's correctness is covered by tests/test_value_cache.py).
+    if os.environ.get("BENCH_E2E_CACHE") != "1":
+        os.environ["CKO_VALUE_CACHE_MB"] = "0"
     text, _pad = _crs_lite_padded(int(os.environ.get("BENCH_RULES_FULL", "800")))
     eng = WafEngine(text)
     bulk = int(os.environ.get("BENCH_E2E_BULK", "2048"))
