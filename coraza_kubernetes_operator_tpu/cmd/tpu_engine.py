@@ -79,6 +79,33 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         help="log every transaction, not just matches (SecAuditEngine On"
         " instead of RelevantOnly)",
     )
+    p.add_argument(
+        "--disable-host-fallback",
+        action="store_true",
+        help="disable degraded-mode serving from the host fallback"
+        " evaluator (reverts to waiting out XLA compiles; the"
+        " failurePolicy alone covers device faults)",
+    )
+    p.add_argument(
+        "--queue-budget",
+        type=int,
+        default=4096,
+        help="batcher backlog above which device-path requests are shed"
+        " with 429 + Retry-After (negative disables shedding)",
+    )
+    p.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=3,
+        help="consecutive device failures before the circuit breaker opens"
+        " and serving demotes to the host fallback",
+    )
+    p.add_argument(
+        "--breaker-cooldown-seconds",
+        type=float,
+        default=30.0,
+        help="cooldown before a half-open device re-probe",
+    )
     args = p.parse_args(argv)
 
     cluster = args.cache_server_cluster
@@ -99,6 +126,10 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         compile_timeout_s=args.compile_timeout_seconds,
         audit_log=args.audit_log or None,
         audit_relevant_only=not args.audit_all,
+        fallback_enabled=not args.disable_host_fallback,
+        queue_budget=args.queue_budget,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_seconds,
     )
 
 

@@ -9,6 +9,7 @@ reference data plane's compiled-once WASM rules).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import jax
@@ -395,6 +396,11 @@ class WafEngine:
             if cache_mb > 0
             else None
         )
+        # Host fallback evaluator (degraded-mode serving): built lazily on
+        # first use — pure NumPy over the same compiled tables, so it can
+        # answer while XLA is still compiling or the device is broken.
+        self._host_fallback = None
+        self._host_fallback_lock = threading.Lock()
         if self.compiled.report.skipped:
             log.info(
                 "compiled with skipped rules",
@@ -406,6 +412,22 @@ class WafEngine:
     @property
     def native_enabled(self) -> bool:
         return self._native.available
+
+    @property
+    def host_fallback(self):
+        """The no-JAX host evaluator over this engine's compiled ruleset
+        (``engine/host_fallback.py``); verdicts are bit-identical to
+        ``evaluate``. Built once on first access (cheap: NumPy table
+        layout, no XLA)."""
+        if self._host_fallback is None:
+            with self._host_fallback_lock:
+                if self._host_fallback is None:
+                    from .host_fallback import HostFallbackEvaluator
+
+                    self._host_fallback = HostFallbackEvaluator(
+                        self.compiled, extractor=self.extractor
+                    )
+        return self._host_fallback
 
     # -- batching -----------------------------------------------------------
 
@@ -576,7 +598,14 @@ class WafEngine:
         miss_keys=None,
     ) -> list[Verdict]:
         from ..models.waf_model import eval_waf_compact_tiered
+        from ..testing.faults import on_device_dispatch
 
+        # Fault-injection hook (no-op when the CKO_FAULT_* knobs are
+        # unset): stalls cold engines like a real first XLA compile and
+        # raises DeviceFault per the configured error rate — the levers
+        # tests/test_degraded_mode.py uses to prove the fallback +
+        # breaker invariants.
+        on_device_dispatch(warmed=self.warmed)
         # One small transfer: device->host readback dominates serving once
         # the host path is native (matched is bit-packed on device and the
         # verdict tensors ride a single packed array).

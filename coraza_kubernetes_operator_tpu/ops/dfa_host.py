@@ -1,0 +1,141 @@
+"""Host-side flat-slot DFA walk — the NumPy twin of the fused device scan.
+
+``ops/dfa_flat.py`` flattens many heterogeneous DFAs into one slot axis
+and steps them with MXU matmuls; this module lays the SAME tables out
+for a scalar walk so the sidecar's degraded-mode fallback evaluator
+(``engine/host_fallback.py``) can produce group hits with zero JAX/XLA
+involvement — no jit, no device, no compile. It must keep answering
+when the accelerator path is cold (first XLA compile in flight), broken
+(circuit breaker open), or absent.
+
+Layout: every (group, local state) pair is one slot; per slot the
+256-column packed table stores ``next_slot_abs + TOTAL_SLOTS * emit``
+for the RAW byte (byte-class compression is pre-resolved through each
+DFA's classmap at build time — a raw-byte column costs host RAM, not
+HBM, and removes one gather per step). One walk step over a batch is
+two NumPy fancy-index gathers on a ``[rows, groups]`` state matrix:
+
+    v     = packed[slots * 256 + byte[:, None]]
+    hit  |= v >= TOTAL
+    slots = v - TOTAL * (v >= TOTAL)
+
+Matcher contract is identical to ``ops/dfa.py:scan_dfa_bank`` and the
+flat device scan: ``matched[b, g]`` == "group g's pattern matched row
+b" under search semantics (emit on transition, match_end at
+end-of-input, ``always_match`` short-circuit). Differential tests pin
+this walker to ``DFA.search`` and to the device path's verdicts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..compiler.re_dfa import DFA
+
+# Length buckets for the walk loop: rows are grouped so short values
+# (headers, args — the vast majority) never pay a long body's byte loop.
+_WALK_BOUNDS = (32, 64, 128, 512, 2048, 8192)
+
+
+class HostFlatDFA:
+    """Flat-slot walk tables for ONE pipeline's group list."""
+
+    def __init__(self, dfas: list[DFA]):
+        self.n_groups = len(dfas)
+        total = sum(max(1, d.n_states) for d in dfas)
+        self.total_slots = total
+        packed = np.zeros(total * 256, dtype=np.int64)
+        init = np.zeros(max(1, self.n_groups), dtype=np.int64)
+        mend = np.zeros(total, dtype=bool)
+        always = np.zeros(self.n_groups, dtype=bool)
+        base = 0
+        for g, d in enumerate(dfas):
+            s = max(1, d.n_states)
+            init[g] = base
+            always[g] = d.always_match
+            if d.n_states:
+                # Resolve the classmap once: a raw-byte column per state
+                # (host RAM is cheap; it removes one gather per step).
+                trans = d.trans[:, d.classmap].astype(np.int64)  # [S, 256]
+                emit = d.emit[:, d.classmap]  # [S, 256] bool
+                block = base + trans + total * emit.astype(np.int64)
+                packed[base * 256 : (base + d.n_states) * 256] = block.reshape(-1)
+            else:
+                # Stateless pad slot: self-loop, never emits.
+                packed[base * 256 : (base + 1) * 256] = base
+            base += s
+        self.packed = packed
+        self.init = init[: self.n_groups]
+        self.mend = mend
+        self.always = always
+        # match_end resolved per slot.
+        base = 0
+        for d in dfas:
+            s = max(1, d.n_states)
+            if d.n_states:
+                self.mend[base : base + d.n_states] = d.match_end
+            base += s
+
+    def search_batch(self, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Walk all groups over a padded byte batch.
+
+        ``data`` [U, L] uint8, ``lengths`` [U] — returns hits [U, G]
+        bool. Rows are processed in length buckets so the byte loop
+        runs ~``len(row)`` steps per bucket, not ``max(len)`` for all."""
+        u = data.shape[0]
+        hits = np.broadcast_to(self.always, (u, self.n_groups)).copy()
+        if self.total_slots == 0 or self.n_groups == 0 or u == 0:
+            return hits
+        lengths = np.minimum(lengths.astype(np.int64), data.shape[1])
+        order = np.argsort(lengths, kind="stable")
+        bounds = [b for b in _WALK_BOUNDS if b < data.shape[1]] + [data.shape[1]]
+        lo = 0
+        for b in bounds:
+            hi = int(np.searchsorted(lengths[order], b, side="right"))
+            if hi > lo:
+                sel = order[lo:hi]
+                hits[sel] |= self._walk(data[sel], lengths[sel])
+                lo = hi
+        return hits
+
+    def _walk(self, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """Walk one length bucket; returns hits [U, G]. Rows that end
+        early are compacted out of the working set (the bucket arrives
+        length-sorted, so the active prefix only ever shrinks)."""
+        u0 = data.shape[0]
+        total = self.total_slots
+        packed = self.packed
+        hits = np.zeros((u0, self.n_groups), dtype=bool)
+        origin = np.arange(u0)
+        slots = np.broadcast_to(self.init, (u0, self.n_groups)).copy()
+        for i in range(int(lengths.max())):
+            active = lengths > i
+            if not active.all():
+                done = ~active
+                hits[origin[done]] |= self.mend[slots[done]]
+                origin = origin[active]
+                if origin.size == 0:
+                    return hits
+                data = data[active]
+                lengths = lengths[active]
+                slots = slots[active]
+            v = packed[slots * 256 + data[:, i].astype(np.int64)[:, None]]
+            emit = v >= total
+            hits[origin] |= emit
+            slots = v - total * emit.astype(np.int64)
+        hits[origin] |= self.mend[slots]
+        return hits
+
+    def search_values(self, values: list[bytes]) -> np.ndarray:
+        """Convenience wrapper: pack a list of byte strings and walk."""
+        u = len(values)
+        if u == 0:
+            return np.zeros((0, self.n_groups), dtype=bool)
+        max_len = max(1, max(len(v) for v in values))
+        data = np.zeros((u, max_len), dtype=np.uint8)
+        lengths = np.zeros(u, dtype=np.int64)
+        for i, v in enumerate(values):
+            if v:
+                data[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
+            lengths[i] = len(v)
+        return self.search_batch(data, lengths)

@@ -171,8 +171,15 @@ def build_segment_block(plans: list[SegmentPlan]) -> SegmentBlock:
             channels.append(key)
         return ch_index[key]
 
-    # Intern segments; collect branch programs.
-    seg_ids: dict[tuple[int, ...], int] = {}
+    # Intern segments; collect branch programs. The intern key must be
+    # (classes, geometry): two segments with identical byte-class
+    # sequences but different lead/trail splits (e.g. `(ALL,)` as a
+    # one-byte lead context vs as a one-byte trailing lookahead) need
+    # DISTINCT ids — seg_meta is per id, and sharing a column across
+    # geometries made every later consumer inherit the first one's
+    # shifts (an order-dependent false negative caught by the host
+    # fallback parity gate on CRS 942120).
+    seg_ids: dict[tuple, int] = {}
     seg_meta: list[tuple[int, int]] = []
     seg_classes: list[tuple[int, ...]] = []
     branches: list[tuple[int, tuple, bool, bool]] = []
@@ -185,12 +192,12 @@ def build_segment_block(plans: list[SegmentPlan]) -> SegmentBlock:
             prog: list[tuple] = []
             for el in br.elements:
                 if isinstance(el, Seg):
-                    key = el.classes
+                    key = (el.classes, el.n_lead, el.n_real)
                     if key not in seg_ids:
                         seg_ids[key] = len(seg_classes)
-                        seg_classes.append(key)
+                        seg_classes.append(el.classes)
                         seg_meta.append((el.n_lead, el.n_real))
-                        w = max(w, len(key))
+                        w = max(w, len(el.classes))
                     prog.append(("seg", seg_ids[key]))
                 else:
                     hi = -1 if el.hi is None else el.hi

@@ -218,8 +218,16 @@ def eval_waf_sharded(mesh: Mesh, model: ShardedWafModel, tensors: tuple):
 
     from ..ops.segment import match_segment_block
 
+    # jax.shard_map landed as a top-level API after 0.4.x; older jaxlibs
+    # (the pinned CI/bench image ships 0.4.37) expose it under
+    # jax.experimental only.
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+    else:  # pragma: no cover - version-dependent import path
+        from jax.experimental.shard_map import shard_map as _shard_map
+
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P("rule"), P(), P(), P("data")),
         out_specs=P("data"),

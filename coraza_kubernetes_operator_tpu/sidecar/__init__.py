@@ -9,7 +9,15 @@ contract the WASM plugin uses (uuid change ⇒ recompile ⇒ swap tables).
 """
 
 from .batcher import MicroBatcher
+from .degraded import CircuitBreaker, DegradedModeManager
 from .reloader import RuleReloader
 from .server import SidecarConfig, TpuEngineSidecar
 
-__all__ = ["MicroBatcher", "RuleReloader", "SidecarConfig", "TpuEngineSidecar"]
+__all__ = [
+    "CircuitBreaker",
+    "DegradedModeManager",
+    "MicroBatcher",
+    "RuleReloader",
+    "SidecarConfig",
+    "TpuEngineSidecar",
+]

@@ -111,6 +111,11 @@ class MicroBatcher:
         # flight" and extend their timeout instead of failing mid-compile.
         self.busy = False
         self.stats = BatcherStats()
+        # Degraded-mode hooks (sidecar/degraded.py): device evaluation
+        # outcomes feed the circuit breaker. Missing-engine windows are
+        # NOT device failures and bypass these.
+        self.on_engine_error = None  # (engine, err) -> None
+        self.on_engine_success = None  # (engine,) -> None
 
     def start(self) -> None:
         self._running = True
@@ -197,6 +202,10 @@ class MicroBatcher:
         # for the whole window even if a hot reload lands mid-grouping.
         tenant_cache: dict[str | None, WafEngine | None] = {}
         for idx, (_req, tenant, _fut) in enumerate(window):
+            if _fut.cancelled():
+                # Deadline-missed request already answered by the host
+                # fallback — don't spend a device slot on it.
+                continue
             if tenant not in tenant_cache:
                 tenant_cache[tenant] = self._engine_fn(tenant)
             engine = tenant_cache[tenant]
@@ -212,7 +221,7 @@ class MicroBatcher:
             )
             self.stats.errors += len(idxs)
             for i in idxs:
-                window[i][2].set_exception(err)
+                _resolve(window[i][2].set_exception, err)
         for key, idxs in groups.items():
             t0 = time.monotonic()
             engine = group_engine[key]
@@ -225,15 +234,30 @@ class MicroBatcher:
             except Exception as err:  # evaluation failure → per-request error
                 log.error("batch evaluation failed", err, batch=len(idxs))
                 self.stats.errors += len(idxs)
+                if self.on_engine_error is not None:
+                    self.on_engine_error(engine, err)
                 for i in idxs:
-                    window[i][2].set_exception(err)
+                    _resolve(window[i][2].set_exception, err)
                 continue
+            if self.on_engine_success is not None:
+                self.on_engine_success(engine)
             for i, verdict in zip(idxs, verdicts):
-                window[i][2].set_result(verdict)
+                _resolve(window[i][2].set_result, verdict)
             # One stats sample per model group: each group is its own
             # device step, so waf_batch_step_seconds / waf_batch_size keep
             # measuring a single device batch even in multi-tenant windows.
             self.stats.record(len(idxs), time.monotonic() - t0)
+
+
+def _resolve(setter, value) -> None:
+    """Set a future's result/exception, tolerating callers that CANCELLED
+    the future (deadline-missed requests re-answered by the fallback
+    cancel their queued submissions so the device never evaluates
+    abandoned work)."""
+    try:
+        setter(value)
+    except Exception:  # InvalidStateError: cancelled by a deadline waiter
+        pass
 
 
 class EngineUnavailable(RuntimeError):

@@ -1,0 +1,292 @@
+"""Degraded-mode serving: mode state machine, promotion, circuit breaker.
+
+The sidecar's hard invariant (docs/DEGRADED_MODE.md): **a verdict is
+always returned before the deadline** — warm caches are an optimization,
+never a precondition. This module owns the per-engine serving-mode state
+machine:
+
+    cold ──ruleset loads──▶ fallback ──first device batch──▶ promoted
+                               ▲                                │
+                               └──── breaker opens (broken) ◀───┘
+
+- **cold**: no compiled ruleset — the Engine ``failurePolicy`` decides
+  (fail-closed 503 / fail-open pass), exactly as before.
+- **fallback**: a ruleset is loaded but its XLA executables are not
+  proven yet. Every request is answered by the host fallback evaluator
+  (``engine/host_fallback.py`` — bit-identical verdicts, no JAX) while a
+  background probe thread runs the first device batch. The moment it
+  completes, the engine atomically promotes (``engine.warmed`` flips).
+- **promoted**: requests ride the micro-batcher/device path.
+- **broken**: N consecutive device failures opened the circuit breaker
+  (CRITICAL log + ``cko_breaker_state`` metric). Serving demotes to the
+  fallback evaluator; after a cooldown one half-open probe per window
+  re-tries the device, closing the breaker on success.
+
+The reference operator carries ``failurePolicy`` for exactly this class
+of failure but its data plane has no second evaluator to fall back on
+(SURVEY §5); the host scalar-DFA path (cf. Hyperflex, arXiv:2512.07123;
+approximate-NFA DPI, arXiv:1904.10786) is fast enough to be that
+stopgap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..engine.request import HttpRequest
+from ..utils import get_logger
+
+log = get_logger("sidecar.degraded")
+
+MODE_COLD = "cold"
+MODE_FALLBACK = "fallback"
+MODE_PROMOTED = "promoted"
+MODE_BROKEN = "broken"
+
+# Numeric codes for the cko_serving_mode gauge.
+MODE_CODES = {MODE_COLD: 0, MODE_FALLBACK: 1, MODE_PROMOTED: 2, MODE_BROKEN: 3}
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+BREAKER_CODES = {BREAKER_CLOSED: 0, BREAKER_OPEN: 1, BREAKER_HALF_OPEN: 2}
+
+
+class Overloaded(RuntimeError):
+    """Admission control rejected the request (queue backlog over budget).
+    The server maps this to 429 + ``Retry-After``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class BreakerOpen(RuntimeError):
+    """The device path is broken and no fallback evaluator is available;
+    the server maps this through the Engine ``failurePolicy`` (fail →
+    403 deny-by-default, allow → pass-through + ``cko_failopen_total``)."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over the device evaluation path."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self.opened_total = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def record_failure(self) -> bool:
+        """Count one device failure; returns True when this failure OPENED
+        the breaker (closed/half-open -> open transition)."""
+        with self._lock:
+            self._consecutive += 1
+            if self._state == BREAKER_OPEN:
+                return False
+            if self._state == BREAKER_HALF_OPEN or self._consecutive >= self.threshold:
+                self._state = BREAKER_OPEN
+                self._opened_at = time.monotonic()
+                self.opened_total += 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._state = BREAKER_CLOSED
+
+    def allow_probe(self) -> bool:
+        """When open past the cooldown, transition to half-open and grant
+        ONE probe; otherwise False."""
+        with self._lock:
+            if self._state != BREAKER_OPEN:
+                return False
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            self._state = BREAKER_HALF_OPEN
+            return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "opened_total": self.opened_total,
+            }
+
+
+def _canary_request() -> HttpRequest:
+    return HttpRequest(
+        method="GET",
+        uri="/__cko_warmup__",
+        headers=[("host", "cko-warmup.local"), ("user-agent", "cko-promote/1")],
+        body=b"",
+    )
+
+
+class DegradedModeManager:
+    """Routes requests between the device path and the host fallback."""
+
+    def __init__(
+        self,
+        fallback_enabled: bool = True,
+        breaker: CircuitBreaker | None = None,
+        probe_backoff_s: float = 0.5,
+        on_fallback=None,
+        is_current=None,
+    ):
+        self.fallback_enabled = fallback_enabled
+        # ONE breaker for the whole sidecar, deliberately: the device is a
+        # shared resource, and the fault storms this guards against
+        # (kernel faults, tunnel drops) are device-wide, not per-model. A
+        # single tenant's model-specific evaluation failure will demote
+        # every tenant to the fallback — accepted: correctness-preserving
+        # (fallback verdicts are identical), and far simpler than
+        # per-engine breakers with stale-engine state cleanup.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.probe_backoff_s = probe_backoff_s
+        self._on_fallback = on_fallback  # optional (n_requests,) metrics hook
+        # is_current(engine) -> bool: False once a hot reload superseded
+        # the engine. Probe loops exit for superseded engines instead of
+        # retrying forever (and feeding the breaker) on behalf of an
+        # engine nothing serves anymore.
+        self._is_current = is_current
+        self._lock = threading.Lock()
+        self._probing: set[int] = set()
+        self._stop = threading.Event()
+        self.promotions = 0
+        self.fallback_requests = 0
+
+    # -- state machine -------------------------------------------------------
+
+    def mode_for(self, engine) -> str:
+        if engine is None:
+            return MODE_COLD
+        if self.breaker.state != BREAKER_CLOSED:
+            return MODE_BROKEN
+        return MODE_PROMOTED if getattr(engine, "warmed", False) else MODE_FALLBACK
+
+    def route(self, engine) -> str:
+        """Pick the serving path for one engine: ``"device"`` or
+        ``"fallback"``. Kicks the background promotion/half-open probe as
+        a side effect. Raises :class:`BreakerOpen` when broken with no
+        fallback available."""
+        mode = self.mode_for(engine)
+        if mode == MODE_PROMOTED:
+            return "device"
+        if mode == MODE_BROKEN:
+            self.ensure_probe(engine)
+            if self.fallback_enabled:
+                return "fallback"
+            raise BreakerOpen(
+                "device path broken (circuit breaker open) and host fallback disabled"
+            )
+        # MODE_FALLBACK: compiled but unproven — promote in the background.
+        self.ensure_probe(engine)
+        if self.fallback_enabled:
+            return "fallback"
+        return "device"  # fallback disabled: legacy wait-out-the-compile path
+
+    def fallback_evaluate(self, engine, requests) -> list:
+        """Evaluate on the host fallback path (counts the requests)."""
+        with self._lock:
+            self.fallback_requests += len(requests)
+        if self._on_fallback is not None:
+            self._on_fallback(len(requests))
+        return engine.host_fallback.evaluate(requests)
+
+    # -- breaker feed --------------------------------------------------------
+
+    def record_device_failure(self, err: BaseException) -> None:
+        opened = self.breaker.record_failure()
+        if opened:
+            # CRITICAL: the data plane lost its device path. Serving
+            # continues on the host fallback (or the failurePolicy).
+            log.critical(
+                "circuit breaker OPEN: device path demoted to host fallback",
+                err,
+                threshold=self.breaker.threshold,
+                cooldown_s=self.breaker.cooldown_s,
+            )
+
+    def record_device_success(self) -> None:
+        self.breaker.record_success()
+
+    # -- promotion / half-open probe ----------------------------------------
+
+    def ensure_probe(self, engine) -> None:
+        """Start (at most one) background thread that proves the engine's
+        device path: the first successful batch both warms the engine
+        (promotion) and closes the breaker."""
+        key = id(engine)
+        with self._lock:
+            if key in self._probing:
+                return
+            if getattr(engine, "warmed", False) and self.breaker.state == BREAKER_CLOSED:
+                return
+            self._probing.add(key)
+        threading.Thread(
+            target=self._probe_loop,
+            args=(engine, key),
+            name=f"cko-promote-{key:x}",
+            daemon=True,
+        ).start()
+
+    def _probe_loop(self, engine, key: int) -> None:
+        backoff = self.probe_backoff_s
+        try:
+            while not self._stop.is_set():
+                if self._is_current is not None and not self._is_current(engine):
+                    # A reload superseded this engine: stop probing on its
+                    # behalf — its failures must not re-open the breaker
+                    # against the engine that replaced it.
+                    return
+                if self.breaker.state == BREAKER_OPEN and not self.breaker.allow_probe():
+                    if self._stop.wait(0.2):
+                        return
+                    continue
+                t0 = time.monotonic()
+                try:
+                    engine.evaluate([_canary_request()])
+                except Exception as err:
+                    self.record_device_failure(err)
+                    if self._stop.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 30.0)
+                    continue
+                self.record_device_success()
+                with self._lock:
+                    self.promotions += 1
+                log.info(
+                    "engine promoted to device serving",
+                    warmup_s=round(time.monotonic() - t0, 2),
+                )
+                return
+        finally:
+            with self._lock:
+                self._probing.discard(key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            probing = len(self._probing)
+        return {
+            "fallback_enabled": self.fallback_enabled,
+            "fallback_requests": self.fallback_requests,
+            "promotions": self.promotions,
+            "probing": probing,
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -30,6 +30,11 @@ from ..utils import get_logger
 log = get_logger("sidecar.reloader")
 
 DEFAULT_POLL_INTERVAL_S = 15.0
+# Failure backoff: after a failed poll the next attempt comes quickly and
+# backs off exponentially up to the normal interval — a transient cache
+# outage must not delay the FIRST ruleset load by a whole poll period
+# (fail-closed sidecars answer 503 until it lands).
+BACKOFF_BASE_S = 0.5
 
 
 class RuleReloader:
@@ -41,7 +46,13 @@ class RuleReloader:
         instance_key: str,
         poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
         engine_factory=WafEngine,
+        on_swap=None,
     ):
+        # on_swap(engine): called after every atomic engine swap — the
+        # sidecar uses it to kick background device promotion for the
+        # fresh engine (degraded-mode serving) without waiting for the
+        # first request to route.
+        self._on_swap = on_swap
         self.cache_base_url = cache_base_url.rstrip("/")
         self.instance_key = instance_key.strip("/")
         self.poll_interval_s = poll_interval_s
@@ -53,6 +64,11 @@ class RuleReloader:
         self._loaded_once = threading.Event()
         self.reloads = 0
         self.failed_reloads = 0
+        # Cache-poll health (degraded-mode observability): total failed
+        # fetches and the current consecutive-failure streak driving the
+        # retry backoff.
+        self.poll_failures = 0
+        self.consecutive_poll_failures = 0
 
     # -- public --------------------------------------------------------------
 
@@ -86,19 +102,41 @@ class RuleReloader:
     def wait_loaded(self, timeout_s: float) -> bool:
         return self._loaded_once.wait(timeout=timeout_s)
 
+    def next_wait_s(self) -> float:
+        """Sleep until the next poll attempt: the normal interval when
+        healthy, exponential backoff (BACKOFF_BASE_S · 2^k, capped at the
+        interval) while the cache server is failing."""
+        k = self.consecutive_poll_failures
+        if k <= 0:
+            return self.poll_interval_s
+        return min(self.poll_interval_s, BACKOFF_BASE_S * (2 ** (k - 1)))
+
+    def _poll_failed(self) -> None:
+        self.poll_failures += 1
+        self.consecutive_poll_failures += 1
+
     def poll_once(self) -> bool:
         """One poll step; returns True if a new ruleset was swapped in."""
         try:
             latest = self._get_json(f"/rules/{self.instance_key}/latest")
         except (urllib.error.URLError, ValueError, OSError) as err:
-            log.debug("cache poll failed", key=self.instance_key, error=str(err))
+            self._poll_failed()
+            log.debug(
+                "cache poll failed",
+                key=self.instance_key,
+                error=str(err),
+                consecutive=self.consecutive_poll_failures,
+                retry_in_s=round(self.next_wait_s(), 2),
+            )
             return False
+        self.consecutive_poll_failures = 0
         uuid = latest.get("uuid")
         if not uuid or uuid == self._uuid:
             return False
         try:
             entry = self._get_json(f"/rules/{self.instance_key}")
         except (urllib.error.URLError, ValueError, OSError) as err:
+            self._poll_failed()
             log.info("rules fetch failed", key=self.instance_key, error=str(err))
             return False
         rules = entry.get("rules", "")
@@ -112,6 +150,11 @@ class RuleReloader:
         self._uuid = uuid
         self.reloads += 1
         self._loaded_once.set()
+        if self._on_swap is not None:
+            try:
+                self._on_swap(engine)
+            except Exception as err:  # promotion kick must not break reload
+                log.error("on_swap hook failed", err)
         log.info(
             "ruleset reloaded",
             key=self.instance_key,
@@ -124,10 +167,13 @@ class RuleReloader:
     # -- internals -----------------------------------------------------------
 
     def _get_json(self, path: str) -> dict:
+        from ..testing.faults import maybe_cache_outage
+
+        maybe_cache_outage()
         with urllib.request.urlopen(self.cache_base_url + path, timeout=10) as resp:
             return json.loads(resp.read().decode())
 
     def _run(self) -> None:
         self.poll_once()  # eager first load, off the caller's thread
-        while not self._stop.wait(self.poll_interval_s):
+        while not self._stop.wait(self.next_wait_s()):
             self.poll_once()

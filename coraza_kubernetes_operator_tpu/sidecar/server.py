@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutTimeout
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -43,6 +45,14 @@ from .batcher import (
     EngineUnavailable,
     MicroBatcher,
 )
+from .degraded import (
+    BREAKER_CODES,
+    MODE_CODES,
+    BreakerOpen,
+    CircuitBreaker,
+    DegradedModeManager,
+    Overloaded,
+)
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .tenants import TENANT_HEADER, TenantManager
 
@@ -51,6 +61,10 @@ log = get_logger("sidecar.server")
 API_PREFIX = "/waf/v1/"
 FAILURE_POLICY_FAIL = "fail"
 FAILURE_POLICY_ALLOW = "allow"
+# Per-request deadline propagation: milliseconds the caller is willing to
+# wait for a verdict. Degraded-mode serving guarantees an answer inside
+# it (fallback evaluator when the device path cannot make the deadline).
+DEADLINE_HEADER = "X-CKO-Deadline-Ms"
 
 
 @dataclass
@@ -104,6 +118,26 @@ class SidecarConfig:
     # rulesets (or pick a lenient tenant — a WAF bypass). Enable only when
     # a trusted proxy in front sets/strips the header.
     trust_tenant_header: bool = False
+    # -- degraded-mode serving (docs/DEGRADED_MODE.md) ----------------------
+    # Host fallback evaluator: serve every request from the no-JAX scalar
+    # path while the engine's XLA executables compile (cold -> fallback ->
+    # promoted) and whenever the circuit breaker is open. Disabling
+    # reverts to the legacy wait-out-the-compile behavior.
+    fallback_enabled: bool = True
+    # Queue admission control: when the batcher backlog exceeds this many
+    # queued requests, new device-path requests are shed with 429 +
+    # Retry-After instead of growing an unbounded queue. Negative
+    # disables shedding.
+    queue_budget: int = 4096
+    shed_retry_after_s: float = 1.0
+    # Concurrent host-fallback evaluations admitted before shedding (the
+    # fallback runs on handler threads; unbounded concurrency on a small
+    # host would thrash). Negative disables.
+    fallback_inflight_budget: int = 64
+    # Circuit breaker: consecutive device failures before opening, and
+    # the cooldown before a half-open re-probe.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -231,6 +265,29 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(503, b"no ruleset loaded\n", {"Content-Type": "text/plain"})
 
+    def _deadline_s(self) -> float | None:
+        """Absolute monotonic deadline from the X-CKO-Deadline-Ms header."""
+        raw = self.headers.get(DEADLINE_HEADER)
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            return None
+        if ms <= 0:
+            return None
+        return _time.monotonic() + ms / 1e3
+
+    def _overloaded(self, err: Overloaded, as_json: bool) -> None:
+        retry = max(1, int(err.retry_after_s + 0.999))
+        headers = {"Retry-After": str(retry)}
+        if as_json:
+            self._reply_json(429, {"error": f"overloaded: {err}"}, headers)
+        else:
+            headers["Content-Type"] = "text/plain"
+            headers["x-waf-action"] = "shed"
+            self._reply(429, b"WAF overloaded, retry later\n", headers)
+
     def _handle_filter(self, body: bytes) -> None:
         req = HttpRequest(
             method=self.command,
@@ -244,7 +301,15 @@ class _Handler(BaseHTTPRequestHandler):
         if self.sidecar.config.trust_tenant_header:
             tenant = self.headers.get(TENANT_HEADER) or None
         try:
-            verdict = self.sidecar.evaluate(req, tenant=tenant)
+            verdict = self.sidecar.evaluate(
+                req, tenant=tenant, deadline_s=self._deadline_s()
+            )
+        except Overloaded as err:
+            self._overloaded(err, as_json=False)
+            return
+        except BreakerOpen:
+            self._breaker_open_filter()
+            return
         except EngineUnavailable:
             self._unavailable()
             return
@@ -278,17 +343,25 @@ class _Handler(BaseHTTPRequestHandler):
         trust = self.sidecar.config.trust_tenant_header
         default_tenant = (self.headers.get(TENANT_HEADER) or None) if trust else None
 
+        deadline_s = self._deadline_s()
+
         # Fast path (the ≥100k req/s serving contract): single-tenant
         # deployments hand the raw JSON body to the native ingest — C++
         # parses, extracts, transforms, and packs rows; Python tiers,
         # dispatches the device step, and streams the verdict array.
-        # Falls through to the object path for tenant routing or when
-        # the native parse rejects the payload (schema errors then get
-        # their descriptive 400 from the Python path).
+        # Falls through to the object path for tenant routing, when the
+        # serving mode is degraded (fallback/broken), or when the native
+        # parse rejects the payload (schema errors then get their
+        # descriptive 400 from the Python path).
         if not trust:
-            fast = self.sidecar.evaluate_bulk_fast(body)
+            try:
+                fast = self.sidecar.evaluate_bulk_fast(body)
+            except BreakerOpen:
+                fast = None
             if fast is not None:
-                self._reply_json(200, {"verdicts": fast})
+                self._reply_json(
+                    200, {"verdicts": fast, "mode": self.sidecar.serving_mode()}
+                )
                 return
 
         try:
@@ -302,7 +375,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(400, {"error": f"invalid request payload: {err}"})
             return
         try:
-            verdicts = self.sidecar.evaluate_many(reqs, tenants=tenants)
+            verdicts = self.sidecar.evaluate_many(
+                reqs, tenants=tenants, deadline_s=deadline_s
+            )
+        except Overloaded as err:
+            self._overloaded(err, as_json=True)
+            return
+        except BreakerOpen:
+            self._breaker_open_bulk(reqs, tenants)
+            return
         except EngineUnavailable:
             self._unavailable()
             return
@@ -317,11 +398,18 @@ class _Handler(BaseHTTPRequestHandler):
             return
         for r, v, t in zip(reqs, verdicts, tenants):
             self.sidecar.record_verdict(r, v, tenant=t)
-        self._reply_json(200, {"verdicts": [verdict_to_json(v) for v in verdicts]})
+        self._reply_json(
+            200,
+            {
+                "verdicts": [verdict_to_json(v) for v in verdicts],
+                "mode": self.sidecar.serving_mode(),
+            },
+        )
 
     def _unavailable(self) -> None:
         # Fail-open: pass the request through unevaluated. Fail-closed: 503.
         if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.sidecar.count_failopen()
             self._reply(
                 200,
                 b"allowed (fail-open: no ruleset loaded)\n",
@@ -332,6 +420,42 @@ class _Handler(BaseHTTPRequestHandler):
                 503,
                 b"WAF unavailable (fail-closed)\n",
                 {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
+            )
+
+    def _breaker_open_filter(self) -> None:
+        """Circuit breaker open with no fallback evaluator: the Engine
+        failurePolicy decides. ``fail`` denies by default (403 — the WAF
+        is refusing traffic it cannot evaluate, not erroring), ``allow``
+        passes through and counts the fail-open."""
+        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.sidecar.count_failopen()
+            self._reply(
+                200,
+                b"allowed (fail-open: breaker open)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
+            )
+        else:
+            self._reply(
+                403,
+                b"blocked by WAF (fail-closed: breaker open)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
+            )
+
+    def _breaker_open_bulk(self, reqs, tenants) -> None:
+        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.sidecar.count_failopen(len(reqs))
+            allow = Verdict(interrupted=False, status=200, rule_id=None)
+            self._reply_json(
+                200,
+                {
+                    "verdicts": [verdict_to_json(allow) for _ in reqs],
+                    "mode": "fail-open",
+                },
+            )
+        else:
+            self._reply_json(
+                503,
+                {"error": "WAF unavailable (fail-closed: circuit breaker open)"},
             )
 
 
@@ -350,6 +474,11 @@ class TpuEngineSidecar:
             cache_base_url=config.cache_base_url,
             tenant_keys=keys or ["default/ruleset"],
             poll_interval_s=config.poll_interval_s,
+            # Kick background device promotion the moment a (re)loaded
+            # engine swaps in — traffic flows from the host fallback until
+            # its first device batch lands. Late-bound: self.degraded is
+            # constructed below.
+            on_swap=lambda engine: self._on_engine_swap(engine),
         )
         if engine is not None:  # pre-seeded (tests / static rules)
             self.tenants.seed(self.tenants.default_tenant, engine)
@@ -386,6 +515,48 @@ class TpuEngineSidecar:
         self.metrics.gauge(
             "waf_tenants", "Resident tenant rulesets"
         ).set_function(lambda: float(len(self.tenants.tenants)))
+        # -- degraded-mode serving ------------------------------------------
+        self._m_fallback = self.metrics.counter(
+            "cko_fallback_requests_total",
+            "Requests answered by the host fallback evaluator",
+        )
+        self._m_shed = self.metrics.counter(
+            "cko_shed_total", "Requests shed by admission control (429)"
+        )
+        self._m_failopen = self.metrics.counter(
+            "cko_failopen_total",
+            "Requests passed through unevaluated under failurePolicy allow",
+        )
+        self.degraded = DegradedModeManager(
+            fallback_enabled=config.fallback_enabled,
+            breaker=CircuitBreaker(
+                threshold=config.breaker_threshold,
+                cooldown_s=config.breaker_cooldown_s,
+            ),
+            on_fallback=lambda n: self._m_fallback.inc(n),
+            is_current=self._engine_is_current,
+        )
+        self.metrics.gauge(
+            "cko_serving_mode",
+            "Serving mode of the default tenant (0 cold, 1 fallback,"
+            " 2 promoted, 3 broken)",
+        ).set_function(
+            lambda: float(MODE_CODES[self.serving_mode()])
+        )
+        self.metrics.gauge(
+            "cko_breaker_state",
+            "Device-path circuit breaker (0 closed, 1 open, 2 half-open)",
+        ).set_function(
+            lambda: float(BREAKER_CODES[self.degraded.breaker.state])
+        )
+        self.batcher.on_engine_error = (
+            lambda _engine, err: self.degraded.record_device_failure(err)
+        )
+        self.batcher.on_engine_success = (
+            lambda _engine: self.degraded.record_device_success()
+        )
+        self._fb_lock = threading.Lock()
+        self._fallback_inflight = 0
         self.batcher.stats.on_batch = self._on_batch
         self.audit: AuditLogger | None = None
         if config.audit_log == "-":
@@ -439,6 +610,61 @@ class TpuEngineSidecar:
         """Back-compat shim: the default tenant's reloader."""
         return self.tenants._reloaders[self.tenants.default_tenant]
 
+    # -- degraded-mode helpers ----------------------------------------------
+
+    def _on_engine_swap(self, engine) -> None:
+        degraded = getattr(self, "degraded", None)
+        if degraded is not None and engine is not None:
+            degraded.ensure_probe(engine)
+
+    def _engine_is_current(self, engine) -> bool:
+        """True while ``engine`` is still some tenant's serving engine —
+        superseded engines' promotion probes exit instead of retrying
+        (and feeding the breaker) forever."""
+        return any(
+            self.tenants.engine_for(key) is engine
+            for key in self.tenants.tenants
+        )
+
+    def serving_mode(self, tenant: str | None = None) -> str:
+        """cold | fallback | promoted | broken (for the given tenant)."""
+        return self.degraded.mode_for(self.tenants.engine_for(tenant))
+
+    def count_failopen(self, n: int = 1) -> None:
+        self._m_failopen.inc(n)
+
+    def _admit_device(self) -> None:
+        """Queue admission control: shed (429) instead of growing an
+        unbounded batcher backlog."""
+        budget = self.config.queue_budget
+        if budget is None or budget < 0:
+            return
+        pending = self.batcher.pending()
+        if pending > budget:
+            self._m_shed.inc()
+            raise Overloaded(
+                f"batcher backlog {pending} over budget {budget}",
+                retry_after_s=self.config.shed_retry_after_s,
+            )
+
+    def _fallback_eval(self, engine, requests: list[HttpRequest]) -> list[Verdict]:
+        """Host-fallback evaluation with its own concurrency admission
+        (the fallback runs on handler threads)."""
+        budget = self.config.fallback_inflight_budget
+        with self._fb_lock:
+            if budget is not None and budget >= 0 and self._fallback_inflight >= budget:
+                self._m_shed.inc()
+                raise Overloaded(
+                    f"host fallback at concurrency budget {budget}",
+                    retry_after_s=self.config.shed_retry_after_s,
+                )
+            self._fallback_inflight += 1
+        try:
+            return self.degraded.fallback_evaluate(engine, requests)
+        finally:
+            with self._fb_lock:
+                self._fallback_inflight -= 1
+
     # -- evaluation ----------------------------------------------------------
 
     def _timeout_for(self, engines) -> float:
@@ -451,13 +677,41 @@ class TpuEngineSidecar:
                 return max(self.config.compile_timeout_s, self.config.request_timeout_s)
         return self.config.request_timeout_s
 
-    def evaluate(self, request: HttpRequest, tenant: str | None = None) -> Verdict:
+    def evaluate(
+        self,
+        request: HttpRequest,
+        tenant: str | None = None,
+        deadline_s: float | None = None,
+    ) -> Verdict:
         engine = self.tenants.engine_for(tenant)
         if engine is None:
             raise EngineUnavailable(f"no compiled ruleset loaded for {tenant!r}")
-        return self.batcher.evaluate(
-            request, timeout_s=self._timeout_for([engine]), tenant=tenant
-        )
+        if self.degraded.route(engine) == "fallback":
+            return self._fallback_eval(engine, [request])[0]
+        self._admit_device()
+        timeout = self._timeout_for([engine])
+        if deadline_s is not None:
+            timeout = max(0.001, min(timeout, deadline_s - _time.monotonic()))
+        fut = self.batcher.submit(request, tenant=tenant)
+        try:
+            return fut.result(timeout=timeout)
+        except EngineUnavailable:
+            raise
+        except Exception as err:
+            # Timeout with no client deadline keeps the legacy contract
+            # (handler -> failurePolicy); anything else — a device error,
+            # or a deadline the device path cannot make — is answered by
+            # the fallback so a verdict still flows.
+            if isinstance(err, (FutTimeout, TimeoutError)) and deadline_s is None:
+                raise
+            if not self.degraded.fallback_enabled:
+                raise
+            # Cancel the queued submission so the device never evaluates
+            # work the fallback is about to answer (the batcher skips
+            # cancelled futures still in its queue).
+            fut.cancel()
+            log.error("device path failed; serving from host fallback", err)
+            return self._fallback_eval(engine, [request])[0]
 
     def evaluate_bulk_fast(self, body: bytes) -> list[dict] | None:
         """Native bulk evaluation for the default tenant. Returns the
@@ -472,13 +726,20 @@ class TpuEngineSidecar:
         engine = self.tenants.engine_for(None)
         if engine is None or not getattr(engine, "native_enabled", False):
             return None
+        # Fast path is device-only: in fallback/broken mode the object
+        # path routes through the host evaluator instead. (BreakerOpen
+        # propagates when the breaker is open and fallback is disabled.)
+        if self.degraded.route(engine) != "device":
+            return None
         try:
             out = engine.evaluate_bulk_json(body)
         except Exception as err:
             log.error("bulk fast path failed; falling back", err)
+            self.degraded.record_device_failure(err)
             return None
         if out is None:
             return None
+        self.degraded.record_device_success()
         verdicts, blob = out
         n_deny = sum(1 for v in verdicts if v.interrupted)
         self._m_requests.inc(n_deny, action="deny")
@@ -515,27 +776,115 @@ class TpuEngineSidecar:
         return [verdict_to_json(v) for v in verdicts]
 
     def evaluate_many(
-        self, requests: list[HttpRequest], tenants: list[str | None] | None = None
+        self,
+        requests: list[HttpRequest],
+        tenants: list[str | None] | None = None,
+        deadline_s: float | None = None,
     ) -> list[Verdict]:
         tenants = tenants or [None] * len(requests)
-        timeout = self._timeout_for(
-            self.tenants.engine_for(t) for t in set(tenants)
-        )
+        engines = {t: self.tenants.engine_for(t) for t in set(tenants)}
+
+        # Route per tenant engine: fallback-mode engines are evaluated
+        # directly on the handler thread (no batcher), device-mode ones
+        # ride the batcher as before. Unknown tenants (engine None) keep
+        # the legacy path — the batcher fails them with EngineUnavailable
+        # and the failurePolicy answers. BreakerOpen propagates when the
+        # breaker is open and the fallback is disabled.
+        routes = {
+            t: (e is not None and self.degraded.route(e) == "fallback")
+            for t, e in engines.items()
+        }
+        fb_idx: dict[str | None, list[int]] = {}
+        dev_idx: list[int] = []
+        for i, t in enumerate(tenants):
+            if routes[t]:
+                fb_idx.setdefault(t, []).append(i)
+            else:
+                dev_idx.append(i)
+        out: list[Verdict | None] = [None] * len(requests)
+        for t, idxs in fb_idx.items():
+            for i, v in zip(
+                idxs, self._fallback_eval(engines[t], [requests[i] for i in idxs])
+            ):
+                out[i] = v
+        if not dev_idx:
+            return out  # type: ignore[return-value]
+
+        self._admit_device()
+        dev_engines = [engines[tenants[i]] for i in dev_idx]
+        try:
+            dev_out = self._evaluate_many_device(
+                [requests[i] for i in dev_idx],
+                [tenants[i] for i in dev_idx],
+                dev_engines,
+                deadline_s,
+            )
+        except EngineUnavailable:
+            raise
+        except Exception as err:
+            # Same degradation contract as evaluate(): legacy timeouts
+            # (no client deadline) propagate; other device failures — or
+            # a deadline the device path cannot make — answer from the
+            # fallback, provided every involved engine has one.
+            legacy_timeout = (
+                isinstance(err, (FutTimeout, TimeoutError)) and deadline_s is None
+            )
+            if (
+                legacy_timeout
+                or not self.degraded.fallback_enabled
+                or any(e is None for e in dev_engines)
+            ):
+                raise
+            log.error("device path failed; serving bulk from host fallback", err)
+            by_tenant: dict[str | None, list[int]] = {}
+            for i in dev_idx:
+                by_tenant.setdefault(tenants[i], []).append(i)
+            for t, idxs in by_tenant.items():
+                for i, v in zip(
+                    idxs,
+                    self._fallback_eval(engines[t], [requests[i] for i in idxs]),
+                ):
+                    out[i] = v
+            return out  # type: ignore[return-value]
+        for i, v in zip(dev_idx, dev_out):
+            out[i] = v
+        return out  # type: ignore[return-value]
+
+    def _evaluate_many_device(
+        self,
+        requests: list[HttpRequest],
+        tenants: list[str | None],
+        engines: list[WafEngine | None],
+        deadline_s: float | None,
+    ) -> list[Verdict]:
+        timeout = self._timeout_for(engines)
         futures: list[Future] = [
             self.batcher.submit(r, tenant=t) for r, t in zip(requests, tenants)
         ]
-        import time as _time
-        from concurrent.futures import TimeoutError as _FutTimeout
-
         # Cold engines get the full compile budget. Warmed engines keep a
         # meaningful SLA: the strict timeout plus a bounded recompile
         # grace (fresh-shape recompiles mid-stream are real, but a wedged
-        # device step must fail clients in timeout+grace, not 600s).
+        # device step must fail clients in timeout+grace, not 600s). A
+        # client deadline caps both.
         if timeout > self.config.request_timeout_s:  # some engine is cold
             hard_budget = timeout
         else:
             hard_budget = timeout + max(0.0, self.config.recompile_grace_s)
         deadline_max = _time.monotonic() + hard_budget
+        if deadline_s is not None:
+            deadline_max = min(deadline_max, deadline_s)
+        try:
+            return self._collect_futures(futures, timeout, deadline_max)
+        except Exception:
+            # The caller may re-answer from the fallback: cancel whatever
+            # is still queued so the device never evaluates abandoned work.
+            for f in futures:
+                f.cancel()
+            raise
+
+    def _collect_futures(
+        self, futures: list[Future], timeout: float, deadline_max: float
+    ) -> list[Verdict]:
         out: list[Verdict] = []
         for f in futures:
             while True:
@@ -543,7 +892,7 @@ class TpuEngineSidecar:
                 try:
                     out.append(f.result(timeout=min(timeout, max(0.001, remaining))))
                     break
-                except _FutTimeout:
+                except FutTimeout:
                     if f.done():
                         # The future COMPLETED with a TimeoutError-typed
                         # engine error (indistinguishable from a wait
@@ -580,6 +929,10 @@ class TpuEngineSidecar:
             "failed_reloads": self.tenants.total_failed_reloads,
             "ready": self.ready(),
             "failure_policy": self.config.failure_policy,
+            "serving_mode": self.serving_mode(),
+            "degraded": self.degraded.stats(),
+            "shed_total": int(self._m_shed.value()),
+            "failopen_total": int(self._m_failopen.value()),
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -587,6 +940,13 @@ class TpuEngineSidecar:
     def start(self) -> None:
         self.batcher.start()
         self.tenants.start()
+        # Kick promotion for engines already resident (seeded/static):
+        # the first device batch runs in the background while the
+        # fallback path answers traffic.
+        for key in self.tenants.tenants:
+            engine = self.tenants.engine_for(key)
+            if engine is not None:
+                self.degraded.ensure_probe(engine)
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, name="sidecar-http", daemon=True
         )
@@ -606,6 +966,7 @@ class TpuEngineSidecar:
         if self._serve_thread:
             self._serve_thread.join(timeout=10)
         self._httpd.server_close()
+        self.degraded.stop()
         self.batcher.stop()
         self.tenants.stop()
         if self.audit is not None:

@@ -37,6 +37,7 @@ class TenantManager:
         tenant_keys: list[str],
         poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
         engine_factory=WafEngine,
+        on_swap=None,
     ):
         self.cache_base_url = cache_base_url
         self.poll_interval_s = poll_interval_s
@@ -45,6 +46,7 @@ class TenantManager:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._engine_factory = engine_factory
+        self._on_swap = on_swap  # forwarded to every tenant's reloader
         for key in tenant_keys:
             self.add(key)
         # Normalized like the reloader keys, so the two never diverge.
@@ -60,6 +62,7 @@ class TenantManager:
                 instance_key=key,
                 poll_interval_s=self.poll_interval_s,
                 engine_factory=self._engine_factory,
+                on_swap=self._on_swap,
             )
 
     def seed(self, key: str, engine: WafEngine) -> None:
@@ -91,6 +94,7 @@ class TenantManager:
                 "uuid": r.current_uuid,
                 "reloads": r.reloads,
                 "failed_reloads": r.failed_reloads,
+                "poll_failures": r.poll_failures,
                 "loaded": r.engine is not None,
             }
             for key, r in reloaders.items()
@@ -125,7 +129,17 @@ class TenantManager:
             reloaders = list(self._reloaders.values())
         return sum(1 for r in reloaders if r.poll_once())
 
+    def _next_wait_s(self) -> float:
+        """Shared-sweep analog of RuleReloader.next_wait_s: any tenant in
+        failure backoff pulls the whole sweep forward (cheap — a sweep is
+        one /latest probe per tenant)."""
+        with self._lock:
+            reloaders = list(self._reloaders.values())
+        if not reloaders:
+            return self.poll_interval_s
+        return min(r.next_wait_s() for r in reloaders)
+
     def _run(self) -> None:
         self.poll_all_once()  # eager first load for every tenant
-        while not self._stop.wait(self.poll_interval_s):
+        while not self._stop.wait(self._next_wait_s()):
             self.poll_all_once()

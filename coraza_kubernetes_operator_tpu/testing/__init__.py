@@ -1,0 +1,17 @@
+"""Test-support utilities shipped with the package.
+
+``testing.faults`` is the fault-injection harness for degraded-mode
+serving (docs/DEGRADED_MODE.md): deterministic, env-driven failures in
+the device path, the compile path, and the cache-poll path, so the
+sidecar's "a verdict is always returned" invariant is testable on any
+backend (including CPU CI) without real hardware faults.
+"""
+
+from .faults import (  # noqa: F401
+    DeviceFault,
+    cache_outage_active,
+    injected_device_error,
+    injected_compile_stall_s,
+    maybe_cache_outage,
+    on_device_dispatch,
+)

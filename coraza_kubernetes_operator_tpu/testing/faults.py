@@ -1,0 +1,109 @@
+"""Fault-injection harness for degraded-mode serving.
+
+Every knob is an environment variable read AT USE TIME (no import-order
+trap: a test can flip a knob between requests), and every injected
+failure is deterministic given the knobs — the error-rate stream comes
+from a seeded PRNG so a fault storm reproduces exactly.
+
+Knobs (all default off):
+
+- ``CKO_FAULT_COMPILE_STALL_S=<seconds>``: the device evaluation path of
+  a NOT-yet-warmed engine sleeps this long before dispatching —
+  simulating the minutes-long first XLA compile of a CRS-scale model
+  (the exact condition that produced five rounds of null bench verdicts,
+  VERDICT r5). Warmed engines are unaffected.
+- ``CKO_FAULT_DEVICE_ERROR_RATE=<0..1>``: each device dispatch raises
+  :class:`DeviceFault` with this probability (1.0 = every dispatch) —
+  simulating the axon tunnel's "TPU device error — often a kernel
+  fault" failure mode. The sidecar's circuit breaker is driven by
+  exactly these errors in tests.
+- ``CKO_FAULT_DEVICE_ERROR_SEED=<int>``: PRNG seed for the error-rate
+  stream (default 0).
+- ``CKO_FAULT_CACHE_OUTAGE=1``: every cache-server poll fails with a
+  connection error — simulating a cache-server outage mid-reload.
+
+The hooks are called from production code (``engine/waf.py``,
+``sidecar/reloader.py``) and are no-ops (a few ns of ``os.environ``
+lookups) when the knobs are unset — the serving hot path never pays for
+the harness.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import urllib.error
+
+
+class DeviceFault(RuntimeError):
+    """An injected device-path failure (stands in for the accelerator
+    runtime's kernel faults / tunnel drops). The sidecar's circuit
+    breaker treats it exactly like a real device error."""
+
+
+_rng_lock = threading.Lock()
+_rng: random.Random | None = None
+_rng_seed: int | None = None
+
+
+def _error_rng() -> random.Random:
+    """Seeded PRNG for the device-error stream; reseeds when the seed
+    knob changes so consecutive tests get independent, reproducible
+    streams."""
+    global _rng, _rng_seed
+    seed = int(os.environ.get("CKO_FAULT_DEVICE_ERROR_SEED", "0"))
+    with _rng_lock:
+        if _rng is None or seed != _rng_seed:
+            _rng = random.Random(seed)
+            _rng_seed = seed
+        return _rng
+
+
+def injected_compile_stall_s() -> float:
+    try:
+        return float(os.environ.get("CKO_FAULT_COMPILE_STALL_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def injected_device_error() -> bool:
+    """True when this dispatch should fail (consumes one PRNG draw)."""
+    try:
+        rate = float(os.environ.get("CKO_FAULT_DEVICE_ERROR_RATE", "0") or 0)
+    except ValueError:
+        return False
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    rng = _error_rng()
+    with _rng_lock:
+        return rng.random() < rate
+
+
+def on_device_dispatch(warmed: bool) -> None:
+    """Called at the top of every device evaluation (engine/waf.py).
+
+    Order matters: the stall runs first (a compiling engine blocks, then
+    may fault), and the error check runs on every dispatch — warmed or
+    not — because device fault storms hit steady-state serving too."""
+    if not warmed:
+        stall = injected_compile_stall_s()
+        if stall > 0:
+            time.sleep(stall)
+    if injected_device_error():
+        raise DeviceFault("injected device error (CKO_FAULT_DEVICE_ERROR_RATE)")
+
+
+def cache_outage_active() -> bool:
+    return os.environ.get("CKO_FAULT_CACHE_OUTAGE", "") not in ("", "0")
+
+
+def maybe_cache_outage() -> None:
+    """Called before every cache-server HTTP fetch (sidecar/reloader.py)."""
+    if cache_outage_active():
+        raise urllib.error.URLError(
+            "injected cache-server outage (CKO_FAULT_CACHE_OUTAGE)"
+        )

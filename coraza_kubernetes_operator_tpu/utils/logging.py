@@ -62,6 +62,12 @@ class Logger:
             kv = {"error": str(err), **kv}
         self._log.error(_render(msg, self._kv(kv)))
 
+    def critical(self, msg: str, err: BaseException | str | None = None, **kv: Any) -> None:
+        """Operator-page severity (breaker opening, data-plane demotion)."""
+        if err is not None:
+            kv = {"error": str(err), **kv}
+        self._log.critical(_render(msg, self._kv(kv)))
+
 
 def get_logger(name: str, **kv: Any) -> Logger:
     return Logger(name, kv or None)

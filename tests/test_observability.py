@@ -8,6 +8,7 @@ and the data plane's SecAuditLog JSON consumed by go-ftw log matching
 import io
 import json
 import re
+import time
 import urllib.request
 
 import pytest
@@ -140,6 +141,12 @@ def test_sidecar_metrics_and_audit(tmp_path):
     )
     side.start()
     try:
+        # Wait for device promotion so the filter singles exercise the
+        # batcher (a cold engine answers from the host fallback, which
+        # records no batch-step samples).
+        deadline = time.time() + 60
+        while side.serving_mode() != "promoted" and time.time() < deadline:
+            time.sleep(0.02)
         code, _ = _get(side.port, "/?q=evil")
         assert code == 403
         code, _ = _get(side.port, "/?q=fine")

@@ -65,8 +65,9 @@ def fresh_sidecar():
 
 def test_fresh_sidecar_first_bulk_never_blank_500(fresh_sidecar):
     """First bulk POST to a cold sidecar: 200 with verdicts, even though
-    request_timeout_s (50 ms) is far below the first-compile time."""
-    assert not fresh_sidecar.tenants.engine_for(None).warmed
+    request_timeout_s (50 ms) is far below the first-compile time. Under
+    degraded-mode serving the cold engine answers from the host fallback
+    while the background probe warms the device path (promotion)."""
     payload = {
         "requests": [
             {"method": "GET", "uri": f"/shop?q=item{i}", "headers": []}
@@ -79,7 +80,12 @@ def test_fresh_sidecar_first_bulk_never_blank_500(fresh_sidecar):
     verdicts = json.loads(body)["verdicts"]
     assert len(verdicts) == 9
     assert verdicts[-1]["interrupted"] and verdicts[-1]["status"] == 403
-    assert fresh_sidecar.tenants.engine_for(None).warmed
+    # Background promotion lands the first device batch shortly after.
+    engine = fresh_sidecar.tenants.engine_for(None)
+    deadline = time.monotonic() + 60
+    while not engine.warmed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert engine.warmed
 
 
 def test_warmed_engine_uses_strict_timeout():

@@ -271,3 +271,78 @@ def test_conv_n2_cols_matches_trace_allocation():
     # guaranteed per-spec, but for this corpus (shared segments across
     # branches) the duplicated count must be >= distinct segments used.
     assert conv_n2_cols(spec) >= 1
+
+
+def test_shared_classes_distinct_geometry_no_collision():
+    """Regression (found by the host-fallback parity gate on CRS 942120):
+    two plans whose segments share the same byte-class sequence but with
+    different lead/trail geometry (a one-byte LEAD context in one plan,
+    the same class as a TRAILING lookahead in another — the ``\\b``
+    encodings produce exactly this) must intern to DISTINCT conv
+    columns. Keying the intern on classes alone made the later plan
+    inherit the first one's (n_lead, n_real) shifts — an order-dependent
+    false negative on CRS rules."""
+    from coraza_kubernetes_operator_tpu.compiler.re_parser import ALL_BYTES
+    from coraza_kubernetes_operator_tpu.compiler.segments import (
+        Branch,
+        Gap,
+        Seg,
+        SegmentPlan,
+    )
+
+    ck = 1 << ord("k")  # the shared byte class
+    cx = 1 << ord("x")
+    gap = Gap(mask=ALL_BYTES, lo=0, hi=None)
+    # Plan A ≈ /x.*(?=k)/ : 'x', any gap, then (k) as trailing lookahead.
+    plan_a = SegmentPlan(
+        branches=(
+            Branch(
+                elements=(
+                    Seg(classes=(cx,)),
+                    gap,
+                    Seg(classes=(ck,), n_lead=0, n_trail=1),
+                ),
+                anchored_start=False,
+                anchored_end=False,
+            ),
+        ),
+        always=False,
+    )
+    # Plan B ≈ /(?<=k)x/ : (k) as a one-byte lead context IMMEDIATELY
+    # followed by 'x' — adjacency makes the lead shift load-bearing (an
+    # unbounded gap would absorb an off-by-one).
+    plan_b = SegmentPlan(
+        branches=(
+            Branch(
+                elements=(
+                    Seg(classes=(ck,), n_lead=1, n_trail=0),
+                    Seg(classes=(cx,)),
+                ),
+                anchored_start=False,
+                anchored_end=False,
+            ),
+        ),
+        always=False,
+    )
+
+    def oracle(pi: int, value: bytes) -> bool:
+        if pi == 0:  # A: an 'x' with a 'k' somewhere at/after the next byte
+            return re.search(rb"x.*(?=k)", value) is not None
+        return re.search(rb"kx", value) is not None  # B
+
+    values = [b"xk", b"kx", b"x123k", b"k123x", b"xxxx", b"kkkk", b"axkb", b"akxb"]
+    for order in ([0, 1], [1, 0]):
+        block = build_segment_block([[plan_a, plan_b][i] for i in order])
+        for value in values:
+            data = np.zeros((1, 8), dtype=np.uint8)
+            data[0, : len(value)] = np.frombuffer(value, dtype=np.uint8)
+            lengths = np.asarray([len(value)], dtype=np.int32)
+            hits = np.asarray(
+                match_segment_block(block.kernel, block.spec, data, lengths)
+            )
+            for col, pi in enumerate(order):
+                assert bool(hits[0, col]) == oracle(pi, value), (
+                    order,
+                    pi,
+                    value,
+                )

@@ -290,6 +290,10 @@ def test_stats_and_batching(cache_server):
     sc.start()
     try:
         assert _wait(sc.ready)
+        # Wait for device promotion: filter-mode singles must exercise the
+        # MicroBatcher, and a still-cold engine serves from the host
+        # fallback instead (degraded-mode serving).
+        assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
         payload = json.dumps(
             {"requests": [{"uri": f"/p{i}"} for i in range(32)]}
         ).encode()
