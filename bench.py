@@ -71,17 +71,66 @@ def _dedup_stats(tiers, n_req: int) -> dict:
     }
 
 
-def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=None):
+def _bench_serve_fn(model, tiers, numvals, masks=None, n_chunks=1):
+    """The serve-loop computation, with the MODEL AS AN OPERAND (not a
+    closure constant): the compiled executable is a function of the
+    shape signature only, so same-layout configs/processes reuse it
+    through the executable cache + the persistent disk cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+
+    def chunk(i):
+        # Perturb EVERY tier's buffer: lax.map hoists loop-invariant
+        # subgraphs, so an untouched tier would be evaluated once per
+        # dispatch instead of once per chunk and the number would
+        # measure only the perturbed tier's marginal work.
+        perturbed = tuple(
+            (t[0].at[0, 0].set(i.astype(jnp.uint8)),) + tuple(t[1:])
+            for t in tiers
+        )
+        out = eval_waf_tiered.__wrapped__(model, perturbed, numvals, masks=masks)
+        return out["interrupted"].sum()
+
+    return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
+
+
+_BENCH_SERVE = None  # jitted lazily (jax import must stay inside configs)
+
+
+def _bench_serve():
+    global _BENCH_SERVE
+    if _BENCH_SERVE is None:
+        import functools
+
+        import jax
+
+        _BENCH_SERVE = functools.partial(
+            jax.jit, static_argnames=("masks", "n_chunks")
+        )(_bench_serve_fn)
+    return _BENCH_SERVE
+
+
+def _serve_throughput(
+    engine, batch: int, iters: int, n_chunks: int, requests=None,
+    measure_warm: bool = False,
+):
     """One-dispatch-many-chunks serving measurement. Returns dict.
 
     Uses the production row-level length-tier path (``tier_tensors`` +
     ``eval_waf_tiered``): tensorize once, rows split by length class,
-    each tier's matcher at its own buffer width, one global post_match."""
+    each tier's matcher at its own buffer width, one global post_match.
+    Dispatch rides the shape-canonical executable cache
+    (``engine/compile_cache.py``); ``measure_warm`` additionally times a
+    from-scratch recompile of the same signature (served from the
+    persistent disk cache → the cost a SECOND process pays) as
+    ``warm_compile_s`` — costs one extra trace, so it stays off for the
+    minutes-to-trace CRS-scale configs."""
     import jax
-    import jax.numpy as jnp
 
     from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
-    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import EXEC_CACHE
 
     m = engine.model
     if requests is None:
@@ -98,31 +147,23 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     dev_tiers = jax.device_put(tiers)
     dev_nv = jax.device_put(numvals)
 
-    @jax.jit
-    def serve(tiers, numvals):
-        def chunk(i):
-            # Perturb EVERY tier's buffer: lax.map hoists loop-invariant
-            # subgraphs, so an untouched tier would be evaluated once per
-            # dispatch instead of once per chunk and the number would
-            # measure only the perturbed tier's marginal work.
-            perturbed = tuple(
-                (t[0].at[0, 0].set(i.astype(jnp.uint8)),) + tuple(t[1:])
-                for t in tiers
-            )
-            out = eval_waf_tiered.__wrapped__(m, perturbed, numvals, masks=masks)
-            return out["interrupted"].sum()
+    serve = _bench_serve()
+    statics = {"masks": masks, "n_chunks": n_chunks}
 
-        return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
+    def dispatch():
+        return EXEC_CACHE.call(serve, (m, dev_tiers, dev_nv), statics, {})
 
+    cc0 = EXEC_CACHE.snapshot()
     t0 = time.perf_counter()
-    out = serve(dev_tiers, dev_nv)
+    out = dispatch()
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
+    cc1 = EXEC_CACHE.snapshot()
 
     walls = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = serve(dev_tiers, dev_nv)
+        out = dispatch()
         jax.block_until_ready(out)
         walls.append(time.perf_counter() - t0)
     per_chunk = [wl / n_chunks for wl in walls]
@@ -137,7 +178,7 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
     # full-model compile through the axon tunnel (~15 min cold,
     # measured blowing the warm budget).
     blocked = int(out[0])
-    return {
+    res = {
         "req_per_s": round(batch / best, 1),
         "p50_chunk_ms": round(p50 * 1e3, 3),
         "p99_chunk_ms": round(p99 * 1e3, 3),
@@ -148,7 +189,26 @@ def _serve_throughput(engine, batch: int, iters: int, n_chunks: int, requests=No
         "compile_s": round(compile_s, 1),
         "tensorize_s": round(tensorize_s, 3),
         "blocked_in_batch": blocked,
+        "compile_cache": {
+            "hits": cc1[0] - cc0[0],
+            "misses": cc1[1] - cc0[1],
+            "xla_compile_s": round(cc1[2] - cc0[2], 2),
+        },
     }
+    if measure_warm:
+        # Recompile the SAME signature from scratch: trace again, then
+        # time only the backend compile — with the persistent cache warm
+        # this deserializes from disk, which is exactly what a cold
+        # process restart pays (the >=5x warm-vs-cold acceptance number).
+        try:
+            lowered = serve.lower(m, dev_tiers, dev_nv, **statics)
+            t0 = time.perf_counter()
+            lowered.compile()
+            res["warm_compile_s"] = round(time.perf_counter() - t0, 3)
+        except Exception as err:
+            res["warm_compile_s"] = None
+            res["warm_compile_error"] = f"{type(err).__name__}: {err}"
+    return res
 
 
 def _crs_lite_padded(n_rules: int):
@@ -225,7 +285,7 @@ def _config_1(iters, n_chunks):
             f'"id:{1000 + i},phase:2,deny,status:403"'
         )
     eng = WafEngine("\n".join(rules))
-    return _serve_throughput(eng, 4096, iters, n_chunks)
+    return _serve_throughput(eng, 4096, iters, n_chunks, measure_warm=True)
 
 
 def _config_2(iters, n_chunks):
@@ -264,7 +324,9 @@ def _config_2(iters, n_chunks):
         attacks[i % len(attacks)] if attacks and rng.random() < 0.3 else benign[i]
         for i in range(4096)
     ]
-    res = _serve_throughput(eng, 4096, iters, n_chunks, requests=reqs)
+    res = _serve_throughput(
+        eng, 4096, iters, n_chunks, requests=reqs, measure_warm=True
+    )
     res["ruleset_source"] = "crs-lite REQUEST-942 + setup"
     res["ftw_attack_stages"] = len(attacks)
     return res
@@ -680,22 +742,33 @@ def _config_5(iters, n_tenants=32):
     }
 
 
-# Headline (3) first so a budget-exhausted run still lands the number
-# the driver grades; 4 last (largest compile).
-_CONFIG_ORDER = ("3", "1", "2", "e2e", "5", "4")
+# Config 2 FIRST: it shares tier/model layouts with config 3 where the
+# rulesets' signatures overlap, so its compiles land in the shared
+# persistent cache before the headline config runs (ISSUE 2). Config 3
+# stays next (budget priority: the graded number must land even on an
+# exhausted run); 4 last (largest compile).
+_CONFIG_ORDER = ("2", "3", "1", "e2e", "5", "4")
 
 
 def _run_config(key: str) -> dict:
     """Run ONE config in this process and return its result dict."""
     import jax
 
-    cache_dir = os.environ.get(
-        "BENCH_XLA_CACHE", str(Path(__file__).parent / ".jax_bench_cache")
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        EXEC_CACHE,
+        configure_persistent_cache,
+    )
+
+    # One shared persistent cache dir across bench children, ftw chunk
+    # children, and the sidecar: BENCH_XLA_CACHE overrides, else the
+    # process-wide CKO_COMPILE_CACHE_DIR, else a repo-local default.
+    cache_dir = (
+        os.environ.get("BENCH_XLA_CACHE")
+        or os.environ.get("CKO_COMPILE_CACHE_DIR")
+        or str(Path(__file__).parent / ".jax_bench_cache")
     )
     if cache_dir != "0":
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        configure_persistent_cache(cache_dir)
 
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     # Chunks/dispatch amortize the axon tunnel's ~100ms per-dispatch cost
@@ -719,8 +792,24 @@ def _run_config(key: str) -> dict:
         "5": lambda: _config_5(iters),
         "e2e": lambda: _config_e2e(iters),
     }
+    cc0 = EXEC_CACHE.snapshot()
     res = runners[key]()
+    cc1 = EXEC_CACHE.snapshot()
     res["platform"] = jax.devices()[0].platform
+    # Whole-config executable-cache delta (covers engine.evaluate paths —
+    # e2e, cached loop, fallback promotion — beyond the serve dispatch):
+    # hits = dispatches that reused a resident executable; misses = fresh
+    # compiles; xla_compile_s near zero means the persistent disk cache
+    # (cache_dir above) served the compiles.
+    res.setdefault("compile_cache", {})
+    res["compile_cache"].update(
+        {
+            "total_hits": cc1[0] - cc0[0],
+            "total_misses": cc1[1] - cc0[1],
+            "total_xla_compile_s": round(cc1[2] - cc0[2], 2),
+            "persistent_dir": cache_dir if cache_dir != "0" else None,
+        }
+    )
     return res
 
 

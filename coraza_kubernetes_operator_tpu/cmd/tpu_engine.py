@@ -106,7 +106,21 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         default=30.0,
         help="cooldown before a half-open device re-probe",
     )
+    p.add_argument(
+        "--compile-cache-dir",
+        default=None,
+        help="persistent XLA compilation cache directory (default"
+        " $CKO_COMPILE_CACHE_DIR): cold sidecar starts warm-start their"
+        " executable compiles from disk; '0' disables",
+    )
     args = p.parse_args(argv)
+
+    # Wire the persistent compile cache BEFORE any engine compiles: a
+    # restart of this sidecar (or any sibling pointed at the same dir)
+    # deserializes yesterday's executables instead of recompiling them.
+    from ..engine.compile_cache import configure_persistent_cache
+
+    configure_persistent_cache(args.compile_cache_dir)
 
     cluster = args.cache_server_cluster
     if ":" in cluster:

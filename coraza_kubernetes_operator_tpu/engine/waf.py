@@ -554,12 +554,7 @@ class WafEngine:
         live = [r for i, r in enumerate(requests) if i not in rejected]
         if not live:
             return [rejected[i] for i in range(len(requests))]
-        if self._native.available:
-            tensors = self._native.tensorize(live)
-        else:
-            extractions = [self.extractor.extract(r) for r in live]
-            tensors = self._tensorize(extractions)
-        tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
+        tiers, numvals, masks, cached, mkeys = self._batch_tensors(live)
         verdicts = self._verdicts_from_tiers(
             tiers, numvals, len(live), masks=masks, cached=cached, miss_keys=mkeys
         )
@@ -599,6 +594,7 @@ class WafEngine:
     ) -> list[Verdict]:
         from ..models.waf_model import eval_waf_compact_tiered
         from ..testing.faults import on_device_dispatch
+        from .compile_cache import EXEC_CACHE
 
         # Fault-injection hook (no-op when the CKO_FAULT_* knobs are
         # unset): stalls cold engines like a real first XLA compile and
@@ -609,13 +605,18 @@ class WafEngine:
         # One small transfer: device->host readback dominates serving once
         # the host path is native (matched is bit-packed on device and the
         # verdict tensors ride a single packed array).
-        out = eval_waf_compact_tiered(
-            self.model,
-            tiers,
-            numvals,
-            max_phase=max_phase,
-            masks=masks,
-            cached=cached,
+        #
+        # Dispatch rides the process-wide executable cache: the compiled
+        # program is a function of the SHAPE SIGNATURE only (tier shapes,
+        # mask tuple, model layout — engine/compile_cache.py), with every
+        # DFA/segment table a runtime operand. Tenants sharing a layout,
+        # hot reloads with an unchanged signature, and repeat bench
+        # configs all reuse one executable instead of recompiling.
+        out = EXEC_CACHE.call(
+            eval_waf_compact_tiered,
+            (self.model, tiers, numvals),
+            {"max_phase": max_phase, "masks": masks},
+            {"cached": cached},
         )
         if cached is None:
             packed = jax.device_get(out)
@@ -660,6 +661,74 @@ class WafEngine:
 
     def evaluate_one(self, request: HttpRequest) -> Verdict:
         return self.evaluate([request])[0]
+
+    # -- AOT pre-warm --------------------------------------------------------
+
+    def batch_signature(self, requests: list[HttpRequest], max_phase: int = 2):
+        """The shape signature the given batch would dispatch under —
+        the executable-cache key (engine/compile_cache.py). Two engines
+        whose signatures match share one compiled executable."""
+        from ..models.waf_model import eval_waf_compact_tiered
+        from .compile_cache import EXEC_CACHE
+
+        tiers, numvals, masks, cached, _mkeys = self._batch_tensors(requests)
+        return EXEC_CACHE.key_for(
+            eval_waf_compact_tiered,
+            (self.model, tiers, numvals, cached),
+            {"max_phase": max_phase, "masks": masks},
+        )
+
+    def _batch_tensors(self, requests: list[HttpRequest]):
+        if self._native.available:
+            tensors = self._native.tensorize(requests)
+        else:
+            extractions = [self.extractor.extract(r) for r in requests]
+            tensors = self._tensorize(extractions)
+        return self.tier_cached(tensors)
+
+    def prewarm(self, requests: list[HttpRequest] | None = None) -> dict:
+        """AOT-lower and pre-compile this engine's executable for the
+        given batch's shape signature WITHOUT executing it — the
+        ``fallback → promoted`` transition runs this off the serving
+        path. Scope is exactly the GIVEN batch's bucketed signature: a
+        production-size batch lands in different row buckets and
+        compiles on its first dispatch unless it was prewarmed too —
+        set ``CKO_PREWARM_BATCH`` to a representative batch size to have
+        the promotion probe additionally warm that signature with
+        synthetic varied traffic (costs a full compile before promotion;
+        the persistent disk cache makes repeat processes cheap).
+        Returns ``{"compiled": bool, "wall_s": float}``."""
+        import time as _time
+
+        from ..models.waf_model import eval_waf_compact_tiered
+        from .compile_cache import EXEC_CACHE
+
+        if requests is None:
+            requests = [
+                HttpRequest(
+                    method="GET",
+                    uri="/__cko_warmup__",
+                    headers=[("host", "cko-warmup.local")],
+                    body=b"",
+                )
+            ]
+        t0 = _time.perf_counter()
+        compiled = False
+        batches = [requests]
+        warm_n = int(_os.environ.get("CKO_PREWARM_BATCH", "0"))
+        if warm_n > 1:
+            from ..corpus import synthetic_requests
+
+            batches.append(synthetic_requests(warm_n, attack_ratio=0.1, seed=7))
+        for batch in batches:
+            tiers, numvals, masks, cached, _mkeys = self._batch_tensors(batch)
+            compiled = EXEC_CACHE.warm(
+                eval_waf_compact_tiered,
+                (self.model, tiers, numvals),
+                {"max_phase": 2, "masks": masks},
+                {"cached": cached},
+            ) or compiled
+        return {"compiled": compiled, "wall_s": _time.perf_counter() - t0}
 
     # -- phase-split serving -------------------------------------------------
 

@@ -192,6 +192,15 @@ class WafModel:
             self.seg_perm,
             self.flat_banks,
         )
+        # CANONICAL aux (shape-canonical executable reuse): the aux tuple
+        # is the jit/AOT cache key's treedef component, so it must contain
+        # ONLY trace-relevant statics. block_kinds/block_cost are host-side
+        # planning metadata (tier_tensors' kind clustering) that never
+        # enters a trace — carrying their ruleset-specific values here made
+        # two same-layout rulesets hash to different executables. They
+        # flatten as () placeholders; unflattened copies (the jit-internal
+        # reconstruction, device_put round trips) see empty tuples, which
+        # no traced code reads.
         aux = (
             self.bank_pipelines,
             self.seg_pipelines,
@@ -203,8 +212,8 @@ class WafModel:
             self.detection_only,
             self.has_removals,
             self.removal_rows,
-            self.block_kinds,
-            self.block_cost,
+            (),  # block_kinds: host-side only, canonicalized out
+            (),  # block_cost: host-side only, canonicalized out
             self.two_pass_counters,
             self.flat_covered,
         )

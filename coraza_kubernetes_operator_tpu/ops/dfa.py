@@ -43,7 +43,15 @@ _STATE_MASK = (1 << _EMIT_SHIFT) - 1
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class DFABank:
-    """G stacked DFAs, padded to common [S, C]."""
+    """G stacked DFAs, padded to common [S, C].
+
+    OPERAND DISCIPLINE (shape-canonical executable reuse,
+    ``engine/compile_cache.py``): every table is a pytree LEAF — a
+    runtime operand — and the aux is None. Moving a table into the aux
+    (or closing over it as a trace-time constant) would bake ruleset
+    content into the executable and break cross-tenant / hot-reload
+    executable sharing; keep new fields leaves unless they change the
+    traced computation's structure."""
 
     packed: jnp.ndarray  # [G, S, C] int32: next_state | (emit << 30)
     classmap: jnp.ndarray  # [256, G] int32 (transposed for row gather)

@@ -76,7 +76,14 @@ def _round_up(n: int, m: int) -> int:
 @dataclass
 class FlatBank:
     """One fused scan bin: N slots over G groups, table segmented by
-    (pipeline, dtype-class) runs along the slot axis."""
+    (pipeline, dtype-class) runs along the slot axis.
+
+    OPERAND DISCIPLINE (shape-canonical executable reuse,
+    ``engine/compile_cache.py``): tables/maps are pytree LEAVES (runtime
+    operands); only slot-layout statics (seg_pipes/seg_slots/group_pipe/
+    pieces — they shape the traced program) live in the aux. Same-layout
+    rulesets then share one compiled executable with their own tables
+    swapped in at call time."""
 
     tables: tuple  # per segment: [256, N_seg] bf16 or f32 (N_seg % 128 == 0)
     sel: jnp.ndarray  # [N, Gp] bf16 0/1: slot -> its group column

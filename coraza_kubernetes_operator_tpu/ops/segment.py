@@ -140,7 +140,15 @@ class SegmentSpec:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class SegmentBlock:
-    """Device arrays + static spec for one pipeline's conv matcher."""
+    """Device arrays + static spec for one pipeline's conv matcher.
+
+    The conv ``kernel`` is a LEAF (runtime operand); ``spec`` is the aux
+    and is genuinely structural — the chain programs it encodes ARE the
+    traced computation, so two rulesets share this block's executable
+    only when their specs match (shape-canonical executable reuse,
+    ``engine/compile_cache.py``). DFA-routed rules have no such static:
+    prefer them when authoring synthetic load that must share
+    executables across rulesets."""
 
     kernel: jnp.ndarray  # [W, C, N] bf16
     spec: SegmentSpec

@@ -258,6 +258,21 @@ class DegradedModeManager:
                     continue
                 t0 = time.monotonic()
                 try:
+                    # AOT pre-warm (shape-canonical executable reuse): lower
+                    # + compile the canary signature WITHOUT executing, so
+                    # the compile happens here — off the serving path — and
+                    # lands in the process-wide executable cache (and the
+                    # persistent disk cache). When a hot reload kept the
+                    # shape signature, this is a pure cache hit: zero XLA
+                    # compiles, promotion in milliseconds.
+                    prewarm = getattr(engine, "prewarm", None)
+                    if prewarm is not None:
+                        warm = prewarm([_canary_request()])
+                        if warm.get("compiled"):
+                            log.info(
+                                "promotion pre-warm compiled executable",
+                                wall_s=round(warm["wall_s"], 2),
+                            )
                     engine.evaluate([_canary_request()])
                 except Exception as err:
                     self.record_device_failure(err)

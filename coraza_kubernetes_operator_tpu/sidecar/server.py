@@ -58,6 +58,13 @@ from .tenants import TENANT_HEADER, TenantManager
 
 log = get_logger("sidecar.server")
 
+
+def _exec_cache_stats() -> dict:
+    from ..engine.compile_cache import EXEC_CACHE
+
+    return EXEC_CACHE.stats()
+
+
 API_PREFIX = "/waf/v1/"
 FAILURE_POLICY_FAIL = "fail"
 FAILURE_POLICY_ALLOW = "allow"
@@ -549,6 +556,39 @@ class TpuEngineSidecar:
         ).set_function(
             lambda: float(BREAKER_CODES[self.degraded.breaker.state])
         )
+        # -- shape-canonical executable reuse (engine/compile_cache.py) -----
+        # Process-wide AOT executable cache: hits = dispatches (and hot
+        # reloads / tenant engines) that reused a resident executable,
+        # misses = fresh XLA compiles, cko_compile_s = seconds spent in
+        # XLA backend compilation (near-zero when the persistent disk
+        # cache is warm). Sampled at render time, same idiom as the
+        # reload counters above.
+        from ..engine.compile_cache import EXEC_CACHE
+
+        self.metrics.gauge(
+            "cko_compile_cache_hits_total",
+            "Device dispatches served by a resident compiled executable",
+        ).set_function(lambda: float(EXEC_CACHE.hits))
+        self.metrics.gauge(
+            "cko_compile_cache_misses_total",
+            "Fresh executable compiles (distinct shape signatures)",
+        ).set_function(lambda: float(EXEC_CACHE.misses))
+        self.metrics.gauge(
+            "cko_compile_s",
+            "Cumulative seconds of XLA backend compilation",
+        ).set_function(lambda: float(EXEC_CACHE.compile_s))
+        self.metrics.gauge(
+            "cko_compile_cache_entries",
+            "Resident compiled executables (distinct shape signatures)",
+        ).set_function(lambda: float(len(EXEC_CACHE)))
+        self.metrics.gauge(
+            "cko_compile_cache_bypass_total",
+            "Dispatches that fell back to plain jit (AOT call rejected)",
+        ).set_function(lambda: float(EXEC_CACHE.bypasses))
+        self.metrics.gauge(
+            "cko_engine_dedup_total",
+            "Tenant engines deduped onto a resident same-ruleset engine",
+        ).set_function(lambda: float(self.tenants.engine_dedup_hits))
         self.batcher.on_engine_error = (
             lambda _engine, err: self.degraded.record_device_failure(err)
         )
@@ -933,6 +973,9 @@ class TpuEngineSidecar:
             "degraded": self.degraded.stats(),
             "shed_total": int(self._m_shed.value()),
             "failopen_total": int(self._m_failopen.value()),
+            "compile_cache": _exec_cache_stats(),
+            "resident_engines": self.tenants.resident_engines(),
+            "engine_dedup_hits": self.tenants.engine_dedup_hits,
         }
 
     # -- lifecycle -----------------------------------------------------------

@@ -17,7 +17,9 @@ unloaded ruleset (failure policy applies).
 
 from __future__ import annotations
 
+import hashlib
 import threading
+import weakref
 
 from ..engine.waf import WafEngine
 from ..utils import get_logger
@@ -26,6 +28,53 @@ from .reloader import DEFAULT_POLL_INTERVAL_S, RuleReloader
 log = get_logger("sidecar.tenants")
 
 TENANT_HEADER = "x-waf-tenant"
+
+
+class SharedEngineFactory:
+    """Dedupe resident engines by compiled-ruleset content hash.
+
+    Tenants fork few base policies (bench config 5's shape: 32 tenants
+    over 4 distinct rulesets), and an engine's device tables + executable
+    signatures are a pure function of its ruleset text — so N tenants on
+    M distinct rulesets must hold M engines, not N. Keying by tenant id
+    (the old behavior) held N full sets of device tables and sent N
+    compile storms through XLA on rollout.
+
+    Entries are weak: when every tenant's reloader has moved off an
+    engine, it (and its device tables) is collectable. Thread-safe; the
+    slow compile runs outside the lock, so two tenants racing the same
+    fresh ruleset may compile twice — the loser is dropped and its
+    executables were shared via the executable cache anyway."""
+
+    def __init__(self, factory=WafEngine):
+        self._factory = factory
+        self._by_hash: weakref.WeakValueDictionary = weakref.WeakValueDictionary()
+        self._lock = threading.Lock()
+        self.dedup_hits = 0
+
+    def __call__(self, rules):
+        if not isinstance(rules, (str, bytes)):
+            return self._factory(rules)  # pre-compiled object: no text key
+        raw = rules.encode("utf-8", "surrogatepass") if isinstance(rules, str) else rules
+        key = hashlib.sha256(raw).hexdigest()
+        with self._lock:
+            engine = self._by_hash.get(key)
+            if engine is not None:
+                self.dedup_hits += 1
+                return engine
+        engine = self._factory(rules)  # compile outside the lock (slow)
+        with self._lock:
+            resident = self._by_hash.get(key)
+            if resident is not None:
+                self.dedup_hits += 1
+                return resident
+            self._by_hash[key] = engine
+            return engine
+
+    @property
+    def resident(self) -> int:
+        with self._lock:
+            return len(self._by_hash)
 
 
 class TenantManager:
@@ -45,7 +94,14 @@ class TenantManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._engine_factory = engine_factory
+        # Content-hash dedupe wraps whatever factory the caller supplied:
+        # tenants polling identical ruleset text share ONE engine object
+        # (and therefore one set of device tables + executables).
+        self._engine_factory = (
+            engine_factory
+            if isinstance(engine_factory, SharedEngineFactory)
+            else SharedEngineFactory(engine_factory)
+        )
         self._on_swap = on_swap  # forwarded to every tenant's reloader
         for key in tenant_keys:
             self.add(key)
@@ -85,6 +141,18 @@ class TenantManager:
         with self._lock:
             reloaders = list(self._reloaders.values())
         return any(r.engine is not None for r in reloaders)
+
+    def resident_engines(self) -> int:
+        """Count of DISTINCT engine objects across tenants (dedupe: 32
+        tenants on 4 rulesets report 4)."""
+        with self._lock:
+            reloaders = list(self._reloaders.values())
+        return len({id(r.engine) for r in reloaders if r.engine is not None})
+
+    @property
+    def engine_dedup_hits(self) -> int:
+        factory = self._engine_factory
+        return factory.dedup_hits if isinstance(factory, SharedEngineFactory) else 0
 
     def stats(self) -> dict:
         with self._lock:

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Warm-vs-cold persistent-compile-cache smoke (ISSUE 2 CI satellite).
+
+Runs the same tiny-ruleset evaluation in two FRESH child processes
+sharing one persistent cache directory and asserts the second process's
+XLA backend-compile time is >= RATIO x faster (default 5x): process 1
+pays real XLA compiles and writes the cache; process 2 re-traces (never
+disk-cached) but deserializes every executable from disk.
+
+The measured quantity is ``ExecutableCache.compile_s`` — backend compile
+seconds only, tracing excluded — so the assertion tests exactly the
+mechanism the cache provides, not host-side noise.
+
+Usage: compile_cache_smoke.py [--ratio 5] [--keep CACHE_DIR]
+Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _child(cache_dir: str) -> None:
+    sys.path.insert(0, str(REPO))
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        EXEC_CACHE,
+        configure_persistent_cache,
+    )
+    from coraza_kubernetes_operator_tpu.engine.request import HttpRequest
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+
+    configure_persistent_cache(cache_dir)
+    rules = "\n".join(
+        ["SecRuleEngine On"]
+        + [
+            f'SecRule ARGS|REQUEST_URI "@contains smokeword{i}" '
+            f'"id:{1000 + i},phase:2,deny,status:403"'
+            for i in range(4)
+        ]
+    )
+    eng = WafEngine(rules)
+    reqs = [
+        HttpRequest(uri="/?q=smokeword1"),
+        HttpRequest(uri="/login", method="POST", body=b"user=a&pass=b"),
+        HttpRequest(uri="/healthz"),
+    ]
+    verdicts = eng.evaluate(reqs)
+    # Two batch shapes => two executables through the cache.
+    eng.evaluate([reqs[0]])
+    print(
+        json.dumps(
+            {
+                **EXEC_CACHE.stats(),
+                "blocked": sum(1 for v in verdicts if v.interrupted),
+            }
+        )
+    )
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+        return 0
+    ratio = 5.0
+    cache_dir = None
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--ratio":
+            ratio = float(args.pop(0))
+        elif a == "--keep":
+            cache_dir = args.pop(0)
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.mkdtemp(prefix="cko-compile-cache-smoke-")
+        cache_dir = tmp
+
+    def run() -> dict:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--child", cache_dir],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+        return json.loads(line)
+
+    try:
+        cold = run()
+        warm = run()
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    # Both processes compile (fresh executable caches); the warm one must
+    # be served from disk. Floor the denominator so a pathologically fast
+    # cold compile can't divide by ~zero.
+    speedup = cold["compile_s"] / max(warm["compile_s"], 1e-3)
+    verdict = {
+        "cold_compile_s": cold["compile_s"],
+        "warm_compile_s": warm["compile_s"],
+        "speedup": round(speedup, 2),
+        "required": ratio,
+        "cold_misses": cold["misses"],
+        "warm_misses": warm["misses"],
+        "blocked": (cold["blocked"], warm["blocked"]),
+    }
+    ok = (
+        speedup >= ratio
+        and cold["misses"] >= 2
+        and warm["misses"] == cold["misses"]  # same signatures re-minted
+        and cold["blocked"] == warm["blocked"] == 1  # verdicts unchanged
+    )
+    verdict["smoke"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

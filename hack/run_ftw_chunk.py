@@ -40,12 +40,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
-_cache_dir = os.environ.get(
-    "CKO_FTW_CACHE", str(REPO / "tests" / ".jax_cache")
+# Shared persistent compile cache (ISSUE 2): CKO_FTW_CACHE keeps its
+# legacy priority, then the process-wide CKO_COMPILE_CACHE_DIR (the same
+# dir the sidecar/bench/CI use — chunk children then warm-start their
+# XLA compiles from whatever any sibling already paid for), then the
+# tests-local default.
+_cache_dir = (
+    os.environ.get("CKO_FTW_CACHE")
+    or os.environ.get("CKO_COMPILE_CACHE_DIR")
+    or str(REPO / "tests" / ".jax_cache")
 )
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from coraza_kubernetes_operator_tpu.engine.compile_cache import (  # noqa: E402
+    configure_persistent_cache,
+)
+
+configure_persistent_cache(_cache_dir)
 
 
 def main() -> None:

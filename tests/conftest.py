@@ -31,10 +31,18 @@ jax.config.update("jax_enable_x64", False)
 # HLO and skip compilation entirely. First run warms it (~10 min);
 # subsequent runs finish in ~1-2 min. Kept under tests/ so `git clean`
 # or a compiler change naturally invalidates it.
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# CKO_COMPILE_CACHE_DIR (the process-wide knob the sidecar, bench, and
+# ftw chunk children share — CI caches it between runs) overrides the
+# tests-local default. configure_persistent_cache is the ONE place the
+# cache is wired (abspath, thresholds, jax cache-latch reset).
+_cache_dir = os.environ.get("CKO_COMPILE_CACHE_DIR") or os.path.join(
+    os.path.dirname(__file__), ".jax_cache"
+)
+from coraza_kubernetes_operator_tpu.engine.compile_cache import (  # noqa: E402
+    configure_persistent_cache,
+)
+
+configure_persistent_cache(_cache_dir)
 
 # Crash-proof cache writes: jaxlib 0.9.0's ``executable.serialize()``
 # SIGSEGVs on certain XLA:CPU executables (reproduced deterministically

@@ -1,0 +1,262 @@
+"""Shape-canonical executable reuse (ISSUE 2).
+
+The compiled executable is a function of the SHAPE SIGNATURE only —
+tier shapes, mask tuple, model layout — with every DFA/segment table a
+runtime operand. These tests pin the three serving-facing invariants:
+
+1. two DISTINCT rulesets sharing one shape signature reuse ONE
+   executable yet produce their own correct (host-fallback-parity)
+   verdicts;
+2. a hot reload on an unchanged signature performs ZERO new compiles;
+3. N tenants on M distinct rulesets hold M resident engines.
+"""
+
+import threading
+
+from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+    EXEC_CACHE,
+    batch_signature,
+)
+from coraza_kubernetes_operator_tpu.engine.request import HttpRequest
+from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+
+# Byte-class-isomorphic patterns (1:1 letter remap): the minimized DFAs
+# have identical state/class counts, so the two rulesets' device tables
+# have identical shapes — the executable-sharing scenario.
+RULES_A = (
+    "SecRuleEngine On\n"
+    'SecRule ARGS "@rx abcdef(?:gh|ij)+k" "id:100,phase:2,deny,status:403"\n'
+)
+RULES_B = (
+    "SecRuleEngine On\n"
+    'SecRule ARGS "@rx mnopqr(?:st|uv)+w" "id:100,phase:2,deny,status:403"\n'
+)
+
+
+def _requests():
+    return [
+        HttpRequest(uri="/?q=abcdefghijk"),  # matches A only
+        HttpRequest(uri="/?q=mnopqrstuvw"),  # matches B only
+        HttpRequest(uri="/?q=benign-value"),
+    ]
+
+
+def test_distinct_rulesets_share_one_executable():
+    eng_a = WafEngine(RULES_A)
+    eng_b = WafEngine(RULES_B)
+    reqs = _requests()
+    assert eng_a.batch_signature(reqs) == eng_b.batch_signature(reqs)
+
+    verdicts_a = eng_a.evaluate(reqs)
+    hits0, misses0, _ = EXEC_CACHE.snapshot()
+    verdicts_b = eng_b.evaluate(reqs)
+    hits1, misses1, _ = EXEC_CACHE.snapshot()
+
+    # Engine B rode engine A's executable: zero new compiles, one hit.
+    assert misses1 == misses0
+    assert hits1 == hits0 + 1
+
+    # ... and still produced ITS OWN verdicts (tables are operands).
+    assert [v.interrupted for v in verdicts_a] == [True, False, False]
+    assert [v.interrupted for v in verdicts_b] == [False, True, False]
+    assert verdicts_a[0].rule_id == verdicts_b[1].rule_id == 100
+
+
+def test_shared_executable_host_fallback_parity():
+    """Verdicts off the shared executable match the no-JAX host fallback
+    evaluator bit-for-bit, for BOTH rulesets."""
+    for rules in (RULES_A, RULES_B):
+        eng = WafEngine(rules)
+        reqs = _requests()
+        device = eng.evaluate(reqs)
+        host = eng.host_fallback.evaluate(reqs)
+        for d, h in zip(device, host):
+            assert (d.interrupted, d.status, d.rule_id, d.matched_ids) == (
+                h.interrupted,
+                h.status,
+                h.rule_id,
+                h.matched_ids,
+            )
+
+
+def test_reload_unchanged_signature_zero_compiles():
+    """The hot-reload path builds a FRESH engine from the same ruleset
+    text; its first batch must not trigger any XLA compile."""
+    reqs = _requests()
+    eng1 = WafEngine(RULES_A)
+    eng1.evaluate(reqs)  # ensures the signature's executable is resident
+
+    _, misses0, compile_s0 = EXEC_CACHE.snapshot()
+    eng2 = WafEngine(RULES_A)  # what RuleReloader.poll_once does on a swap
+    verdicts = eng2.evaluate(reqs)
+    _, misses1, compile_s1 = EXEC_CACHE.snapshot()
+
+    assert misses1 == misses0, "reload on unchanged signature recompiled"
+    assert compile_s1 == compile_s0
+    assert [v.interrupted for v in verdicts] == [True, False, False]
+
+
+def test_prewarm_compiles_off_path_then_serves_hit():
+    eng = WafEngine(RULES_B)
+    canary = [HttpRequest(uri="/__warm__", headers=[("host", "h")])]
+    out = eng.prewarm(canary)
+    # First prewarm for this signature either compiles or finds it
+    # resident from an earlier test run; a SECOND prewarm must not.
+    assert out["compiled"] in (True, False)
+    _, misses0, _ = EXEC_CACHE.snapshot()
+    assert eng.prewarm(canary)["compiled"] is False
+    verdicts = eng.evaluate(canary)
+    _, misses1, _ = EXEC_CACHE.snapshot()
+    assert misses1 == misses0, "evaluate after prewarm should be compile-free"
+    assert not verdicts[0].interrupted
+
+
+def test_batch_signature_canonical_under_host_metadata():
+    """block_kinds/block_cost are host-side planning metadata: they must
+    not enter the executable key (WafModel flattens them as ())."""
+    import jax
+
+    eng = WafEngine(RULES_A)
+    leaves, treedef = jax.tree_util.tree_flatten(eng.model)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.block_kinds == ()
+    assert rebuilt.block_cost == ()
+    # Signature helper is stable and hashable.
+    sig = batch_signature((eng.model,), ())
+    assert hash(sig) == hash(batch_signature((eng.model,), ()))
+
+
+def test_tenant_engines_dedupe_by_ruleset_hash():
+    """32 tenants over 4 distinct rulesets hold 4 engines (bench config
+    5's shape) — resident engines key on content hash, not tenant id."""
+    from coraza_kubernetes_operator_tpu.sidecar.tenants import (
+        SharedEngineFactory,
+    )
+
+    built = []
+
+    def factory(rules):
+        eng = WafEngine(rules)
+        built.append(eng)
+        return eng
+
+    shared = SharedEngineFactory(factory)
+    texts = [
+        "SecRuleEngine On\n"
+        f'SecRule ARGS "@contains tenant-model-{i}" '
+        f'"id:{200 + i},phase:2,deny,status:403"\n'
+        for i in range(4)
+    ]
+    engines = [shared(texts[i % 4]) for i in range(32)]
+    assert len(built) == 4
+    assert len({id(e) for e in engines}) == 4
+    assert shared.dedup_hits == 28
+    assert shared.resident == 4
+    # Routing correctness survives sharing: each tenant's engine blocks
+    # its own model's payload and passes a sibling's.
+    v = engines[5].evaluate_one(HttpRequest(uri="/?q=tenant-model-1"))
+    assert v.interrupted and v.rule_id == 201
+    assert not engines[5].evaluate_one(
+        HttpRequest(uri="/?q=tenant-model-2")
+    ).interrupted
+
+
+def test_tenant_manager_wraps_factory_and_counts_residents():
+    from coraza_kubernetes_operator_tpu.cache import (
+        RuleSetCache,
+        RuleSetCacheServer,
+    )
+    from coraza_kubernetes_operator_tpu.sidecar.tenants import TenantManager
+
+    cache = RuleSetCache()
+    text = (
+        "SecRuleEngine On\n"
+        'SecRule ARGS "@contains shared-attack" '
+        '"id:300,phase:2,deny,status:403"\n'
+    )
+    keys = [f"ns{i}/rs" for i in range(6)]
+    for k in keys:
+        cache.put(k, text)  # every tenant polls the SAME ruleset
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        mgr = TenantManager(
+            cache_base_url=f"http://127.0.0.1:{srv.port}",
+            tenant_keys=keys,
+            poll_interval_s=3600,
+        )
+        assert mgr.poll_all_once() == 6
+        assert mgr.resident_engines() == 1
+        assert mgr.engine_dedup_hits == 5
+        assert mgr.engine_for("ns0/rs") is mgr.engine_for("ns5/rs")
+        v = mgr.engine_for("ns3/rs").evaluate_one(
+            HttpRequest(uri="/?q=shared-attack")
+        )
+        assert v.interrupted and v.rule_id == 300
+    finally:
+        srv.stop()
+
+
+def test_exec_cache_thread_safe_single_resident():
+    """Concurrent same-signature dispatches keep ONE resident executable
+    and produce identical results."""
+    eng = WafEngine(RULES_A)
+    reqs = _requests()
+    # Two warm passes: the first populates the cross-batch VALUE cache,
+    # which changes the second pass's tier shapes (cached rows replace
+    # matcher rows) — the steady-state signature the threads then race.
+    eng.evaluate(reqs)
+    eng.evaluate(reqs)
+    entries0 = len(EXEC_CACHE)
+    results = [None] * 4
+    errs = []
+
+    def work(i):
+        try:
+            results[i] = [v.interrupted for v in eng.evaluate(reqs)]
+        except Exception as e:  # pragma: no cover - diagnostic
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert all(r == [True, False, False] for r in results)
+    assert len(EXEC_CACHE) == entries0
+
+
+def test_degraded_probe_prewarms_before_canary():
+    """The promotion probe AOT-prewarms the canary signature off the
+    serving path before proving the device with a real batch."""
+    from coraza_kubernetes_operator_tpu.sidecar.degraded import (
+        DegradedModeManager,
+    )
+
+    calls = []
+
+    class FakeEngine:
+        warmed = False
+
+        def prewarm(self, requests=None):
+            calls.append(("prewarm", len(requests or [])))
+            return {"compiled": True, "wall_s": 0.01}
+
+        def evaluate(self, requests):
+            calls.append(("evaluate", len(requests)))
+            self.warmed = True
+            return [None] * len(requests)
+
+    mgr = DegradedModeManager(probe_backoff_s=0.01)
+    eng = FakeEngine()
+    mgr.ensure_probe(eng)
+    deadline = threading.Event()
+    for _ in range(200):
+        if eng.warmed:
+            break
+        deadline.wait(0.05)
+    assert eng.warmed
+    assert calls[0][0] == "prewarm"
+    assert ("evaluate", 1) in calls
+    mgr.stop()

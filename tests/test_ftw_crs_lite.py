@@ -74,6 +74,14 @@ def _run_corpus_chunked(
     repo = Path(__file__).resolve().parents[1]
     runner = repo / "hack" / "run_ftw_chunk.py"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    # Chunk children share ONE persistent compile cache with this parent
+    # (and the sidecar/bench/CI): CKO_COMPILE_CACHE_DIR when set, else
+    # the tests-local dir conftest.py configured. The ~3-min per-child
+    # jit TRACING is paid per process, but the XLA-compile half is paid
+    # once per HLO across all children and gate invocations.
+    env.setdefault(
+        "CKO_COMPILE_CACHE_DIR", str(repo / "tests" / ".jax_cache")
+    )
 
     # Compile once, ship the artifact: each child previously re-ran ~30s
     # of compile_rules host work (VERDICT r4 item 4); the persistent
