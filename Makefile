@@ -51,6 +51,10 @@ ftw.crs-lite:  ## Conformance: crs-lite corpus (CRS v4-structured) in-process.
 bench:  ## Streaming JSON benchmark: one line per config + final summary.
 	$(PYTHON) bench.py
 
+.PHONY: pipeline.smoke
+pipeline.smoke:  ## Host/device overlap gate: pipelined >= 1.2x sync, verdicts identical.
+	$(PYTHON) hack/pipeline_smoke.py
+
 # bench.warm populates .jax_bench_cache with the FINAL compiler's HLO so
 # the driver's timed run hits a warm XLA cache (VERDICT r3 item 1d). Runs
 # every config once with minimal iters; throughput output is discarded.

@@ -37,7 +37,9 @@ Env overrides: BENCH_CONFIGS (comma list of 1..5,e2e), BENCH_ITERS,
 BENCH_CHUNKS, BENCH_RULES_FULL (default 800), BENCH_RULES_XL (extra @rx
 rules for config #4, default 1000), BENCH_BATCH_XL (default 65536),
 BENCH_CONFIG_BUDGET_S / BENCH_BUDGET_<KEY>, BENCH_TOTAL_BUDGET_S,
-BENCH_INPROC=1 (no subprocesses, no budget enforcement).
+BENCH_INPROC=1 (no subprocesses, no budget enforcement),
+BENCH_PIPE_BATCH / BENCH_PIPE_BATCHES / CKO_PIPELINE_DEPTH (config 3's
+pipelined-vs-sync prepare/collect pass — docs/PIPELINE.md).
 """
 
 import json
@@ -209,6 +211,49 @@ def _serve_throughput(
             res["warm_compile_s"] = None
             res["warm_compile_error"] = f"{type(err).__name__}: {err}"
     return res
+
+
+def _pipelined_serving(eng, batch: int, n_batches: int, depth: int = 2):
+    """Pipelined vs synchronous two-stage serving (ISSUE 4): N DISTINCT
+    request batches run once strictly alternating (prepare then collect,
+    host and device serialized — the pre-pipeline hot path) and once
+    double-buffered (window i+1's prepare overlaps window i's device
+    step; bounded in-flight depth), through the SAME
+    ``WafEngine.prepare``/``collect`` split the sidecar batcher rides.
+
+    The measurement discipline (untimed warm of every batch signature,
+    value-cache bypass for shape stability, deque double buffer) lives
+    in ``testing/overlap.py`` — one copy shared with the CI gate
+    (``hack/pipeline_smoke.py``) so bench and gate can never drift.
+    Per-stage means (host assemble / device step / decode) come from the
+    sync pass's ``InFlightBatch`` timings — the overlap target the
+    pipelined number should approach is max(host, device+decode)."""
+    from coraza_kubernetes_operator_tpu.testing.overlap import measure_overlap
+
+    batches = [
+        _ftw_replay_requests(batch, seed=5000 + i)[0] for i in range(n_batches)
+    ]
+    m = measure_overlap(eng, batches, depth=depth)
+    n_req = batch * n_batches
+    return {
+        "req_per_s": round(n_req / m["pipe_wall"], 1),
+        "req_per_s_sync": round(n_req / m["sync_wall"], 1),
+        "speedup_vs_sync": round(m["sync_wall"] / m["pipe_wall"], 3),
+        "depth": depth,
+        "batches": n_batches,
+        "batch": batch,
+        "stage_s": {
+            "host_assemble": round(m["host_s"] / n_batches, 4),
+            "device_step": round(m["device_s"] / n_batches, 4),
+            "decode": round(m["decode_s"] / n_batches, 5),
+        },
+        "value_cache": "bypassed (stable shapes)",
+        "compile_cache": m["compile_cache"],
+        "boundary": (
+            "host prepare (extract+tensorize+tier+dispatch) vs device"
+            " step+readback; per-dispatch axon tunnel cost included"
+        ),
+    }
 
 
 def _crs_lite_padded(n_rules: int):
@@ -434,6 +479,30 @@ def _config_3(iters, n_chunks, n_rules):
     res["seg_groups"] = sum(s.n_groups for s in eng.model.segs)
     res["ruleset_source"] = f"crs-lite + {pad} crs-grade synthetic @rx"
     res["ftw_attack_stages"] = n_attacks
+    # Stream the device headline BEFORE the pipelined pass: if the
+    # pipelined block's warm compile blows the wall budget, the kill
+    # costs only that block, never the graded number.
+    _emit({**res, "pipeline": "pending (pre-pipeline partial line)"})
+
+    # Pipelined two-stage serving (ISSUE 4): double-buffered
+    # prepare/collect overlap vs the strictly alternating loop, with
+    # per-stage timings. One extra executable (the compact-tiered
+    # signature at the pipeline batch size) compiles on a cold cache —
+    # bench.warm and the persistent disk cache make the driver run a
+    # cache hit — so the block is skipped when the remaining budget
+    # could not absorb a cold compile.
+    if remaining() > 120:
+        try:
+            res["pipeline"] = _pipelined_serving(
+                eng,
+                min(int(os.environ.get("BENCH_PIPE_BATCH", "2048")), len(reqs)),
+                int(os.environ.get("BENCH_PIPE_BATCHES", "8")),
+                depth=int(os.environ.get("CKO_PIPELINE_DEPTH", "2")),
+            )
+        except Exception as err:
+            res["pipeline"] = {"error": f"{type(err).__name__}: {err}"}
+    else:
+        res["pipeline"] = {"skipped": "insufficient budget margin"}
 
     # Cross-batch value-cache serving (round-5 lever #3): distinct
     # batches, repeated VALUES — reported with its hit rate. Off by

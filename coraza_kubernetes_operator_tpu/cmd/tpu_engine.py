@@ -57,6 +57,14 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
     p.add_argument(
         "--max-batch-delay-ms", type=float, default=DEFAULT_MAX_BATCH_DELAY_MS
     )
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=None,
+        help="max batch windows in flight on device while the next one"
+        " assembles (double-buffered dispatch, docs/PIPELINE.md); default"
+        " $CKO_PIPELINE_DEPTH or 2, 1 reverts to synchronous dispatch",
+    )
     p.add_argument("--request-timeout-seconds", type=float, default=30.0)
     p.add_argument(
         "--compile-timeout-seconds",
@@ -134,6 +142,7 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         failure_policy=args.failure_policy,
         max_batch_size=args.max_batch_size,
         max_batch_delay_ms=args.max_batch_delay_ms,
+        pipeline_depth=args.pipeline_depth,
         host=args.bind_address,
         port=args.port,
         request_timeout_s=args.request_timeout_seconds,

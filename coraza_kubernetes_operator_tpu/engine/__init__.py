@@ -9,4 +9,4 @@ reload protocol as the reference's WASM plugin
 """
 
 from .request import HttpRequest  # noqa: F401
-from .waf import Verdict, WafEngine  # noqa: F401
+from .waf import InFlightBatch, Verdict, WafEngine  # noqa: F401

@@ -10,6 +10,7 @@ reference data plane's compiled-once WASM rules).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -294,6 +295,36 @@ class Verdict:
         return not self.interrupted
 
 
+@dataclass
+class InFlightBatch:
+    """A dispatched-but-not-collected batch window (pipelined serving).
+
+    ``WafEngine.prepare`` returns one: the batch is tensorized, tiered,
+    and its device step ENQUEUED (JAX async dispatch — no host sync has
+    happened), so the caller can assemble and dispatch the next window
+    while this one's executable runs on device. ``WafEngine.collect``
+    blocks on the readback and decodes the verdicts. Decode state (rule
+    ids, public counters, value cache) lives on the engine, so
+    ``collect`` must be called on the engine whose ``prepare`` built the
+    batch — the sidecar batcher pins that pairing per window group
+    (``batcher._Group.engine``), which is what lets a hot reload
+    mid-flight complete on the engine that dispatched it (verdicts are
+    never dropped or re-evaluated)."""
+
+    out: object  # device output: packed array, (packed, tier_hits), or None
+    n_live: int
+    n_requests: int
+    rejected: dict[int, Verdict]
+    miss_keys: list | None
+    cache_pop: bool  # out carries tier hit rows for value-cache population
+    # Stage timings (observability + bench): host_s is filled by prepare
+    # (extract + tensorize + tier + dispatch enqueue); device_s/decode_s
+    # by collect (readback block / verdict decode).
+    host_s: float = 0.0
+    device_s: float = 0.0
+    decode_s: float = 0.0
+
+
 class WafEngine:
     """A compiled ruleset plus its jitted batch evaluator."""
 
@@ -320,6 +351,13 @@ class WafEngine:
             }
             for r in self.compiled.rules
         }
+        # Internal synthetic counters (ctl gating) stay out of verdicts;
+        # resolved once here — _decode_packed runs per collected window.
+        self._public_counters: list[tuple[int, str]] = [
+            (c, name)
+            for c, name in enumerate(self.compiled.counters)
+            if not name.startswith("__")
+        ]
         self._host_pipelines = self.compiled.host_pipelines()
         # Kinds visible to each host pipeline — rows outside the set skip the
         # (sequential, Python) transform on the hot path.
@@ -518,9 +556,26 @@ class WafEngine:
         tiers (``tier_tensors``), each tier's matcher runs at its own
         buffer width, and one global post_match reduces all rows by
         req_id. Tiering is a pure batching policy — row↔tier assignment
-        can never change a verdict, only a tier's padding width."""
+        can never change a verdict, only a tier's padding width.
+
+        This is exactly ``collect(prepare(requests))`` — the pipelined
+        two-stage path with zero windows in flight — so pipelined and
+        synchronous verdicts are bit-identical by construction."""
         if not requests:
             return []
+        return self.collect(self.prepare(requests))
+
+    # -- pipelined two-stage serving ----------------------------------------
+
+    def prepare(self, requests: list[HttpRequest]) -> InFlightBatch:
+        """Stage 1 of the pipelined hot path: extract + tensorize + tier
+        on host, then ENQUEUE the device step (JAX async dispatch) and
+        return without any host↔device sync. While the returned window's
+        executable runs on device, the caller (``sidecar/batcher.py``)
+        assembles and dispatches the next window — host CPU work and
+        device compute overlap instead of strictly alternating."""
+
+        t0 = time.perf_counter()
         prog = self.compiled.program
         rejected: dict[int, Verdict] = {}
         if (
@@ -533,7 +588,8 @@ class WafEngine:
             # wins over the 413. ProcessPartial instead evaluates the
             # truncated prefix (the [:limit] slice in extract()). All
             # over-limit requests ride ONE batched phase-1 dispatch (an
-            # all-over-limit batch must not serialize per request).
+            # all-over-limit batch must not serialize per request);
+            # this pre-pass is rare and stays synchronous inside prepare.
             over = [
                 i
                 for i, r in enumerate(requests)
@@ -553,17 +609,57 @@ class WafEngine:
                     )
         live = [r for i, r in enumerate(requests) if i not in rejected]
         if not live:
-            return [rejected[i] for i in range(len(requests))]
+            return InFlightBatch(
+                out=None,
+                n_live=0,
+                n_requests=len(requests),
+                rejected=rejected,
+                miss_keys=None,
+                cache_pop=False,
+                host_s=time.perf_counter() - t0,
+            )
         tiers, numvals, masks, cached, mkeys = self._batch_tensors(live)
-        verdicts = self._verdicts_from_tiers(
+        inflight = self._dispatch_tiers(
             tiers, numvals, len(live), masks=masks, cached=cached, miss_keys=mkeys
         )
-        if not rejected:
+        inflight.n_requests = len(requests)
+        inflight.rejected = rejected
+        inflight.host_s = time.perf_counter() - t0
+        return inflight
+
+    def collect(self, inflight: InFlightBatch) -> list[Verdict]:
+        """Stage 2 of the pipelined hot path: block on the device
+        readback of a ``prepare``d window, populate the value cache from
+        its miss rows, and decode the packed verdict array. FIFO
+        collection order is the caller's contract (the batcher's
+        collector thread drains windows in dispatch order)."""
+
+        if inflight.out is None:
+            return [
+                inflight.rejected[i] for i in range(inflight.n_requests)
+            ]
+        t0 = time.perf_counter()
+        if inflight.cache_pop:
+            packed, tier_hits = jax.device_get(inflight.out)
+            if self.value_cache is not None and inflight.miss_keys is not None:
+                for keys, hp in zip(inflight.miss_keys, tier_hits):
+                    if keys:
+                        self.value_cache.insert(keys, hp[: len(keys)])
+        else:
+            packed = jax.device_get(inflight.out)
+        self.warmed = True
+        t1 = time.perf_counter()
+        inflight.device_s = t1 - t0
+        verdicts = self._decode_packed(packed, inflight.n_live)
+        inflight.decode_s = time.perf_counter() - t1
+        if not inflight.rejected:
             return verdicts
         out: list[Verdict] = []
         it = iter(verdicts)
-        for i in range(len(requests)):
-            out.append(rejected[i] if i in rejected else next(it))
+        for i in range(inflight.n_requests):
+            out.append(
+                inflight.rejected[i] if i in inflight.rejected else next(it)
+            )
         return out
 
     def tier(self, tensors):
@@ -582,7 +678,7 @@ class WafEngine:
             tensors, self._kind_block_lut, cache=self.value_cache
         )
 
-    def _verdicts_from_tiers(
+    def _dispatch_tiers(
         self,
         tiers,
         numvals,
@@ -591,7 +687,11 @@ class WafEngine:
         masks=None,
         cached=None,
         miss_keys=None,
-    ) -> list[Verdict]:
+    ) -> InFlightBatch:
+        """Enqueue one tiered batch on device (no host sync) and return
+        the in-flight handle. The single dispatch site shared by the
+        synchronous path (``_verdicts_from_tiers``) and the pipelined
+        path (``prepare``) — the two can never drift."""
         from ..models.waf_model import eval_waf_compact_tiered
         from ..testing.faults import on_device_dispatch
         from .compile_cache import EXEC_CACHE
@@ -602,9 +702,10 @@ class WafEngine:
         # tests/test_degraded_mode.py uses to prove the fallback +
         # breaker invariants.
         on_device_dispatch(warmed=self.warmed)
-        # One small transfer: device->host readback dominates serving once
-        # the host path is native (matched is bit-packed on device and the
-        # verdict tensors ride a single packed array).
+        # One small transfer at collect time: device->host readback
+        # dominates serving once the host path is native (matched is
+        # bit-packed on device and the verdict tensors ride a single
+        # packed array).
         #
         # Dispatch rides the process-wide executable cache: the compiled
         # program is a function of the SHAPE SIGNATURE only (tier shapes,
@@ -618,43 +719,64 @@ class WafEngine:
             {"max_phase": max_phase, "masks": masks},
             {"cached": cached},
         )
-        if cached is None:
-            packed = jax.device_get(out)
-        else:
-            packed, tier_hits = jax.device_get(out)
-            if self.value_cache is not None and miss_keys is not None:
-                for keys, hp in zip(miss_keys, tier_hits):
-                    if keys:
-                        self.value_cache.insert(keys, hp[: len(keys)])
-        self.warmed = True
-        return self._decode_packed(packed, n_requests)
+        return InFlightBatch(
+            out=out,
+            n_live=n_requests,
+            n_requests=n_requests,
+            rejected={},
+            miss_keys=miss_keys,
+            cache_pop=cached is not None,
+        )
+
+    def _verdicts_from_tiers(
+        self,
+        tiers,
+        numvals,
+        n_requests: int,
+        max_phase: int = 2,
+        masks=None,
+        cached=None,
+        miss_keys=None,
+    ) -> list[Verdict]:
+        return self.collect(
+            self._dispatch_tiers(
+                tiers,
+                numvals,
+                n_requests,
+                max_phase=max_phase,
+                masks=masks,
+                cached=cached,
+                miss_keys=miss_keys,
+            )
+        )
 
     def _decode_packed(self, packed, n_requests: int) -> list[Verdict]:
-        from ..models.waf_model import unpack_compact
+        """Batch NumPy decode of the packed verdict array: one nonzero
+        pass over the whole matched matrix instead of a per-request
+        ``np.flatnonzero`` loop, so the collect stage stays O(batch)
+        host work and cannot become the pipeline's serial bottleneck."""
+        from ..models.waf_model import matched_id_lists, unpack_compact
 
         head, matched, scores = unpack_compact(
             packed, self.model.n_rules, self.model.n_counters
         )
-        # Internal synthetic counters (ctl gating) stay out of verdicts.
-        counters = [
-            (c, name)
-            for c, name in enumerate(self.compiled.counters)
-            if not name.startswith("__")
-        ]
+        id_lists = matched_id_lists(
+            matched, self._rule_ids, self._n_real_rules, n_requests
+        )
+        head_rows = head[:n_requests].tolist()
+        score_rows = scores[:n_requests].tolist()
+        counters = self._public_counters
         verdicts: list[Verdict] = []
         for i in range(n_requests):
-            ridx = int(head[i, 2])
+            interrupted, status, ridx = head_rows[i]
+            row = score_rows[i]
             verdicts.append(
                 Verdict(
-                    interrupted=bool(head[i, 0]),
-                    status=int(head[i, 1]),
+                    interrupted=bool(interrupted),
+                    status=int(status),
                     rule_id=int(self._rule_ids[ridx]) if ridx >= 0 else None,
-                    matched_ids=[
-                        int(self._rule_ids[j])
-                        for j in np.flatnonzero(matched[i])
-                        if j < self._n_real_rules  # drop the ≥1-row pad rule
-                    ],
-                    scores={name: int(scores[i, c]) for c, name in counters},
+                    matched_ids=id_lists[i],
+                    scores={name: row[c] for c, name in counters},
                 )
             )
         return verdicts
@@ -690,7 +812,13 @@ class WafEngine:
         """AOT-lower and pre-compile this engine's executable for the
         given batch's shape signature WITHOUT executing it — the
         ``fallback → promoted`` transition runs this off the serving
-        path. Scope is exactly the GIVEN batch's bucketed signature: a
+        path. The warmed signature is the ONE dispatch site
+        (``_dispatch_tiers``) that both the synchronous path and the
+        pipelined ``prepare``/``collect`` path ride, so promotion never
+        eats a first-dispatch stall on either: after prewarm, the
+        batcher's first pipelined window is a pure executable-cache hit
+        (tests/test_pipeline.py asserts the zero-miss invariant).
+        Scope is exactly the GIVEN batch's bucketed signature: a
         production-size batch lands in different row buckets and
         compiles on its first dispatch unless it was prewarmed too —
         set ``CKO_PREWARM_BATCH`` to a representative batch size to have
@@ -698,7 +826,6 @@ class WafEngine:
         synthetic varied traffic (costs a full compile before promotion;
         the persistent disk cache makes repeat processes cheap).
         Returns ``{"compiled": bool, "wall_s": float}``."""
-        import time as _time
 
         from ..models.waf_model import eval_waf_compact_tiered
         from .compile_cache import EXEC_CACHE
@@ -712,7 +839,7 @@ class WafEngine:
                     body=b"",
                 )
             ]
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         compiled = False
         batches = [requests]
         warm_n = int(_os.environ.get("CKO_PREWARM_BATCH", "0"))
@@ -728,7 +855,7 @@ class WafEngine:
                 {"max_phase": 2, "masks": masks},
                 {"cached": cached},
             ) or compiled
-        return {"compiled": compiled, "wall_s": _time.perf_counter() - t0}
+        return {"compiled": compiled, "wall_s": time.perf_counter() - t0}
 
     # -- phase-split serving -------------------------------------------------
 

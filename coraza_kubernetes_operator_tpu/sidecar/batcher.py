@@ -9,10 +9,23 @@ The reference has no analog (Envoy evaluates per request inside the WASM
 sandbox); batching is precisely the TPU-shaped redesign: the MXU wants
 thousands of rows per step, and XLA's async dispatch overlaps the next
 window's assembly with the current device step.
+
+**Pipelined dispatch (double buffering).** The loop is split into two
+stages riding ``WafEngine.prepare`` / ``WafEngine.collect``
+(docs/PIPELINE.md): the dispatch thread assembles window N+1 and enqueues
+its device step while window N's executable is still running on device;
+a dedicated collector thread drains in-flight windows in STRICT dispatch
+order (FIFO — verdicts are never reordered) and resolves their futures.
+In-flight depth is bounded (``CKO_PIPELINE_DEPTH``, default 2 — classic
+double buffering), so the existing backpressure path still engages: when
+the device falls behind, windows queue in the submit queue, ``pending()``
+grows, and the server's admission control sheds with 429.
 """
 
 from __future__ import annotations
 
+import math
+import os
 import queue
 import threading
 import time
@@ -27,6 +40,23 @@ log = get_logger("sidecar.batcher")
 
 DEFAULT_MAX_BATCH_SIZE = 2048
 DEFAULT_MAX_BATCH_DELAY_MS = 1.0
+# Bounded in-flight window depth (double buffering). Depth 1 degenerates
+# to the synchronous alternate-host-and-device loop; depth 2 overlaps one
+# assembling window with one executing window; deeper helps only when
+# host assembly is much faster than the device step AND arrival bursts
+# outpace both.
+DEFAULT_PIPELINE_DEPTH = 2
+
+
+def _nearest_rank(sorted_samples: list[float], p: float) -> float:
+    """Nearest-rank percentile: the ceil(p*n)-th smallest sample. The old
+    ``int(len * p)`` indexing over-read by one whenever p*n landed on an
+    integer (p50 of 4 samples returned the 3rd; p99 of 100 returned the
+    max instead of the 99th)."""
+    if not sorted_samples:
+        return 0.0
+    idx = max(0, math.ceil(p * len(sorted_samples)) - 1)
+    return sorted_samples[min(len(sorted_samples) - 1, idx)]
 
 
 @dataclass
@@ -38,7 +68,12 @@ class BatcherStats:
     errors: int = 0
     batch_sizes: list[int] = field(default_factory=list)
     step_latencies_s: list[float] = field(default_factory=list)
+    # Pipelined stage samples: host assemble (tensorize+tier+dispatch
+    # enqueue) vs device step (readback block + decode) per window group.
+    host_stage_s: list[float] = field(default_factory=list)
+    device_stage_s: list[float] = field(default_factory=list)
     on_batch: object = None  # optional (size, latency_s) hook for metrics
+    on_stage: object = None  # optional (host_s, device_s) hook for metrics
     _max_samples: int = 4096
 
     def record(self, size: int, latency_s: float) -> None:
@@ -52,14 +87,19 @@ class BatcherStats:
         if self.on_batch is not None:
             self.on_batch(size, latency_s)  # type: ignore[operator]
 
+    def record_stage(self, host_s: float, device_s: float) -> None:
+        if len(self.host_stage_s) >= self._max_samples:
+            del self.host_stage_s[: self._max_samples // 2]
+            del self.device_stage_s[: self._max_samples // 2]
+        self.host_stage_s.append(host_s)
+        self.device_stage_s.append(device_s)
+        if self.on_stage is not None:
+            self.on_stage(host_s, device_s)  # type: ignore[operator]
+
     def snapshot(self) -> dict:
         lats = sorted(self.step_latencies_s)
-
-        def pct(p: float) -> float:
-            if not lats:
-                return 0.0
-            return lats[min(len(lats) - 1, int(len(lats) * p))]
-
+        hosts = sorted(self.host_stage_s)
+        devs = sorted(self.device_stage_s)
         return {
             "batches": self.batches,
             "requests": self.requests,
@@ -67,18 +107,44 @@ class BatcherStats:
             "mean_batch_size": (
                 sum(self.batch_sizes) / len(self.batch_sizes) if self.batch_sizes else 0.0
             ),
-            "p50_step_ms": pct(0.50) * 1e3,
-            "p99_step_ms": pct(0.99) * 1e3,
+            "p50_step_ms": _nearest_rank(lats, 0.50) * 1e3,
+            "p99_step_ms": _nearest_rank(lats, 0.99) * 1e3,
+            "p50_host_stage_ms": _nearest_rank(hosts, 0.50) * 1e3,
+            "p99_host_stage_ms": _nearest_rank(hosts, 0.99) * 1e3,
+            "p50_device_stage_ms": _nearest_rank(devs, 0.50) * 1e3,
+            "p99_device_stage_ms": _nearest_rank(devs, 0.99) * 1e3,
         }
 
 
-class MicroBatcher:
-    """Submit requests; a background thread forms batches and evaluates them.
+@dataclass
+class _Group:
+    """One engine's share of a dispatched window."""
 
-    ``engine_fn`` is called at the top of every batch so an atomic engine
-    swap (hot reload) takes effect on the next window without pausing the
-    loop. A ``None`` engine fails every request in the window with
-    ``EngineUnavailable`` — the server maps that through the failure policy.
+    engine: WafEngine | None
+    idxs: list[int]
+    t_dispatch: float
+    inflight: object = None  # InFlightBatch (pipelined path)
+    verdicts: list[Verdict] | None = None  # sync path (phase_split / stubs)
+    error: BaseException | None = None
+
+
+@dataclass
+class _WindowRecord:
+    window: list
+    groups: list
+
+
+class MicroBatcher:
+    """Submit requests; background threads form, dispatch, and collect
+    batch windows.
+
+    ``engine_fn`` is called at the top of every window so an atomic engine
+    swap (hot reload) takes effect on the NEXT window without pausing the
+    loop; windows already in flight pin the engine that dispatched them
+    and drain to completion on it — a reload never drops or re-evaluates
+    an in-flight verdict. A ``None`` engine fails every request in the
+    window with ``EngineUnavailable`` — the server maps that through the
+    failure policy.
     """
 
     def __init__(
@@ -87,9 +153,13 @@ class MicroBatcher:
         max_batch_size: int = DEFAULT_MAX_BATCH_SIZE,
         max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
         phase_split: bool = False,
+        pipeline_depth: int | None = None,
     ):
         # phase_split: evaluate phase-1 (headers) before body ingest —
-        # early denials never tensorize their bodies (SURVEY §3.4).
+        # early denials never tensorize their bodies (SURVEY §3.4). The
+        # phased path has no prepare/collect split (two dependent device
+        # passes), so its windows evaluate synchronously in the dispatch
+        # stage and ride the in-flight queue only for FIFO ordering.
         self.phase_split = phase_split
         # engine_fn(tenant) -> WafEngine | None. Single-tenant callers may
         # pass a zero-arg callable; it is adapted below.
@@ -101,15 +171,22 @@ class MicroBatcher:
             self._engine_fn = engine_fn
         self.max_batch_size = max(1, int(max_batch_size))
         self.max_batch_delay_s = max(0.0, float(max_batch_delay_ms)) / 1e3
+        if pipeline_depth is None:
+            pipeline_depth = int(
+                os.environ.get("CKO_PIPELINE_DEPTH", str(DEFAULT_PIPELINE_DEPTH))
+            )
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self._queue: queue.Queue[
             tuple[HttpRequest, str | None, Future] | None
         ] = queue.Queue()
+        self._inflight: queue.Queue[_WindowRecord | None] = queue.Queue()
+        self._depth_sem = threading.Semaphore(self.pipeline_depth)
+        self._inflight_lock = threading.Lock()
+        self._inflight_count = 0
+        self._window_open = False
         self._thread: threading.Thread | None = None
+        self._collector: threading.Thread | None = None
         self._running = False
-        # True while a window is being evaluated on device. Lets waiters
-        # distinguish "stuck" from "a (re)compile or big step is in
-        # flight" and extend their timeout instead of failing mid-compile.
-        self.busy = False
         self.stats = BatcherStats()
         # Degraded-mode hooks (sidecar/degraded.py): device evaluation
         # outcomes feed the circuit breaker. Missing-engine windows are
@@ -117,16 +194,61 @@ class MicroBatcher:
         self.on_engine_error = None  # (engine, err) -> None
         self.on_engine_success = None  # (engine,) -> None
 
+    @property
+    def busy(self) -> bool:
+        """True while a window is being assembled/dispatched or any
+        window is in flight on device. Lets waiters distinguish "stuck"
+        from "a (re)compile or big step is in flight" and extend their
+        timeout instead of failing mid-compile."""
+        with self._inflight_lock:
+            return self._window_open or self._inflight_count > 0
+
+    def inflight_windows(self) -> int:
+        """Windows dispatched but not yet collected (the
+        ``cko_inflight_windows`` gauge)."""
+        with self._inflight_lock:
+            return self._inflight_count
+
     def start(self) -> None:
         self._running = True
         self._thread = threading.Thread(target=self._run, name="batcher", daemon=True)
         self._thread.start()
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="batcher-collect", daemon=True
+        )
+        self._collector.start()
 
     def stop(self) -> None:
+        """Drain deterministically: the dispatch thread exits, every
+        window already in flight is COLLECTED (its futures resolve with
+        real verdicts), then still-queued submissions fail fast.
+
+        The collector's shutdown sentinel must land AFTER the dispatch
+        thread's last window. If the dispatch thread outlives the
+        bounded join here (e.g. mid-prepare in a minutes-long cold
+        compile), a watchdog waits it out and enqueues the sentinel
+        then — stop() stays bounded, and the straggler window still
+        collects (in the background) instead of abandoning its futures
+        behind an early sentinel."""
         self._running = False
         self._queue.put(None)
-        if self._thread:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        if t is not None and t.is_alive():
+            def _sentinel_after_dispatch():
+                t.join()
+                self._inflight.put(None)
+
+            threading.Thread(
+                target=_sentinel_after_dispatch,
+                name="batcher-drain",
+                daemon=True,
+            ).start()
+        else:
+            self._inflight.put(None)
+        if self._collector:
+            self._collector.join(timeout=30)
         self._drain_pending()
 
     def _drain_pending(self) -> None:
@@ -140,7 +262,7 @@ class MicroBatcher:
             except queue.Empty:
                 return
             if item is not None:
-                item[2].set_exception(err)
+                _resolve(item[2].set_exception, err)
 
     def submit(self, request: HttpRequest, tenant: str | None = None) -> Future:
         """Enqueue one request; the Future resolves to its Verdict."""
@@ -157,7 +279,7 @@ class MicroBatcher:
     ) -> Verdict:
         return self.submit(request, tenant=tenant).result(timeout=timeout_s)
 
-    # -- batch loop ----------------------------------------------------------
+    # -- dispatch stage ------------------------------------------------------
 
     def _run(self) -> None:
         while self._running:
@@ -165,30 +287,56 @@ class MicroBatcher:
             if item is None:
                 continue
             if not self._running:
-                item[2].set_exception(EngineUnavailable("batcher stopped"))
+                _resolve(item[2].set_exception, EngineUnavailable("batcher stopped"))
                 continue
-            window: list[tuple[HttpRequest, str | None, Future]] = [item]
-            deadline = time.monotonic() + self.max_batch_delay_s
-            while len(window) < self.max_batch_size:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    break
-                window.append(nxt)
-            self.busy = True
+            with self._inflight_lock:
+                self._window_open = True
             try:
-                self._evaluate_window(window)
+                window: list[tuple[HttpRequest, str | None, Future]] = [item]
+                deadline = time.monotonic() + self.max_batch_delay_s
+                while len(window) < self.max_batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        break
+                    window.append(nxt)
+                self._dispatch_or_fail(window)
             finally:
-                self.busy = False
+                with self._inflight_lock:
+                    self._window_open = False
 
-    def _evaluate_window(
+    def _dispatch_or_fail(self, window) -> None:
+        """Acquire an in-flight slot (bounded depth — THE backpressure
+        point: while the device is ``pipeline_depth`` windows behind,
+        assembly blocks here, the submit queue grows, and admission
+        control sheds), then dispatch."""
+        while not self._depth_sem.acquire(timeout=0.1):
+            if not self._running:
+                err = EngineUnavailable("batcher stopped")
+                for _req, _tenant, fut in window:
+                    _resolve(fut.set_exception, err)
+                return
+        with self._inflight_lock:
+            self._inflight_count += 1
+        try:
+            record = self._dispatch_window(window)
+        except BaseException:
+            # _dispatch_window is defensive per group; anything escaping
+            # it must still release the slot or the pipeline deadlocks.
+            with self._inflight_lock:
+                self._inflight_count -= 1
+            self._depth_sem.release()
+            raise
+        self._inflight.put(record)
+
+    def _dispatch_window(
         self, window: list[tuple[HttpRequest, str | None, Future]]
-    ) -> None:
+    ) -> _WindowRecord:
         # Group the window by the tenant's COMPILED MODEL, not by tenant
         # name: tenants typically fork a few base policies, so windows
         # touching many tenants still coalesce into one device step per
@@ -215,38 +363,114 @@ class MicroBatcher:
             key = id(engine)
             group_engine[key] = engine
             groups.setdefault(key, []).append(idx)
+        out_groups: list[_Group] = []
         for tenant, idxs in missing.items():
-            err = EngineUnavailable(
-                f"no compiled ruleset loaded for tenant {tenant!r}"
+            out_groups.append(
+                _Group(
+                    engine=None,
+                    idxs=idxs,
+                    t_dispatch=time.monotonic(),
+                    error=EngineUnavailable(
+                        f"no compiled ruleset loaded for tenant {tenant!r}"
+                    ),
+                )
             )
-            self.stats.errors += len(idxs)
-            for i in idxs:
-                _resolve(window[i][2].set_exception, err)
         for key, idxs in groups.items():
-            t0 = time.monotonic()
             engine = group_engine[key]
+            g = _Group(engine=engine, idxs=idxs, t_dispatch=time.monotonic())
+            reqs = [window[i][0] for i in idxs]
             try:
-                reqs = [window[i][0] for i in idxs]
-                if self.phase_split:
-                    verdicts = engine.evaluate_phased(reqs)
+                if self.phase_split or not hasattr(engine, "prepare"):
+                    # Synchronous group (phase-split or a stub engine
+                    # without the two-stage API): evaluated here, riding
+                    # the in-flight queue for FIFO resolution only.
+                    if self.phase_split:
+                        g.verdicts = engine.evaluate_phased(reqs)
+                    else:
+                        g.verdicts = engine.evaluate(reqs)
                 else:
-                    verdicts = engine.evaluate(reqs)
-            except Exception as err:  # evaluation failure → per-request error
-                log.error("batch evaluation failed", err, batch=len(idxs))
-                self.stats.errors += len(idxs)
-                if self.on_engine_error is not None:
-                    self.on_engine_error(engine, err)
-                for i in idxs:
-                    _resolve(window[i][2].set_exception, err)
+                    g.inflight = engine.prepare(reqs)
+            except Exception as err:  # dispatch failure → per-request error
+                g.error = err
+            out_groups.append(g)
+        return _WindowRecord(window=window, groups=out_groups)
+
+    # -- collect stage -------------------------------------------------------
+
+    def _collect_loop(self) -> None:
+        while True:
+            record = self._inflight.get()
+            if record is None:
+                # stop() enqueues the sentinel AFTER the dispatch thread
+                # exits, so every dispatched window was already drained.
+                return
+            try:
+                self._collect_record(record)
+            except Exception as err:
+                # Backstop: anything escaping per-group handling must
+                # not kill the collector — queued windows would never
+                # resolve and the depth-slot pool would drain while the
+                # sidecar still looks alive. Fail this record's
+                # unresolved futures and keep collecting.
+                log.error("window collect failed", err)
+                for _req, _tenant, fut in record.window:
+                    if not fut.done():
+                        _resolve(fut.set_exception, err)
+            finally:
+                with self._inflight_lock:
+                    self._inflight_count -= 1
+                self._depth_sem.release()
+
+    def _collect_record(self, record: _WindowRecord) -> None:
+        for g in record.groups:
+            if g.error is None and g.verdicts is None:
+                try:
+                    g.verdicts = g.engine.collect(g.inflight)
+                except Exception as err:
+                    g.error = err
+            if g.error is not None:
+                if g.engine is None:
+                    # Missing-engine group: a routing condition, not a
+                    # device failure — never feeds the breaker.
+                    self.stats.errors += len(g.idxs)
+                    for i in g.idxs:
+                        _resolve(record.window[i][2].set_exception, g.error)
+                    continue
+                log.error("batch evaluation failed", g.error, batch=len(g.idxs))
+                self.stats.errors += len(g.idxs)
+                self._notify(self.on_engine_error, g.engine, g.error)
+                for i in g.idxs:
+                    _resolve(record.window[i][2].set_exception, g.error)
                 continue
-            if self.on_engine_success is not None:
-                self.on_engine_success(engine)
-            for i, verdict in zip(idxs, verdicts):
-                _resolve(window[i][2].set_result, verdict)
+            self._notify(self.on_engine_success, g.engine)
+            for i, verdict in zip(g.idxs, g.verdicts):
+                _resolve(record.window[i][2].set_result, verdict)
             # One stats sample per model group: each group is its own
             # device step, so waf_batch_step_seconds / waf_batch_size keep
-            # measuring a single device batch even in multi-tenant windows.
-            self.stats.record(len(idxs), time.monotonic() - t0)
+            # measuring a single device batch even in multi-tenant
+            # windows. Latency spans dispatch start -> collect end: the
+            # true window residency a caller observes under pipelining.
+            try:
+                self.stats.record(len(g.idxs), time.monotonic() - g.t_dispatch)
+                inflight = g.inflight
+                if inflight is not None:
+                    self.stats.record_stage(
+                        getattr(inflight, "host_s", 0.0),
+                        getattr(inflight, "device_s", 0.0)
+                        + getattr(inflight, "decode_s", 0.0),
+                    )
+            except Exception as err:  # metrics hooks must not fail verdicts
+                log.error("batch stats hook failed", err)
+
+    def _notify(self, hook, *args) -> None:
+        """Degraded-mode/metrics hooks are side channels: a raising hook
+        must never decide a verdict or kill the collector."""
+        if hook is None:
+            return
+        try:
+            hook(*args)
+        except Exception as err:
+            log.error("batcher hook failed", err)
 
 
 def _resolve(setter, value) -> None:

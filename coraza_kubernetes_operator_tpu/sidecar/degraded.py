@@ -273,7 +273,16 @@ class DegradedModeManager:
                                 "promotion pre-warm compiled executable",
                                 wall_s=round(warm["wall_s"], 2),
                             )
-                    engine.evaluate([_canary_request()])
+                    # Prove the exact path the batcher serves on: the
+                    # pipelined prepare/collect split (one dispatch site
+                    # with the synchronous path, so the prewarmed
+                    # signature is a pure cache hit here and promotion
+                    # never eats a first-dispatch stall on either path).
+                    prepare = getattr(engine, "prepare", None)
+                    if prepare is not None:
+                        engine.collect(prepare([_canary_request()]))
+                    else:
+                        engine.evaluate([_canary_request()])
                 except Exception as err:
                     self.record_device_failure(err)
                     if self._stop.wait(backoff):

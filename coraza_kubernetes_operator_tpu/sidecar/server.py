@@ -88,6 +88,11 @@ class SidecarConfig:
     failure_policy: str = FAILURE_POLICY_FAIL
     max_batch_size: int = DEFAULT_MAX_BATCH_SIZE
     max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS
+    # Pipelined dispatch (docs/PIPELINE.md): max windows in flight on
+    # device while the batcher assembles the next (double buffering).
+    # None reads CKO_PIPELINE_DEPTH (default 2); 1 reverts to the
+    # synchronous alternate-host-and-device loop.
+    pipeline_depth: int | None = None
     host: str = "0.0.0.0"
     port: int = 9090
     request_timeout_s: float = 30.0
@@ -494,6 +499,7 @@ class TpuEngineSidecar:
             max_batch_size=config.max_batch_size,
             max_batch_delay_ms=config.max_batch_delay_ms,
             phase_split=config.phase_split,
+            pipeline_depth=config.pipeline_depth,
         )
         self.metrics = MetricsRegistry()
         self._m_requests = self.metrics.counter(
@@ -509,6 +515,24 @@ class TpuEngineSidecar:
         self._m_step = self.metrics.histogram(
             "waf_batch_step_seconds", "Device batch step latency"
         )
+        # -- pipelined dispatch (docs/PIPELINE.md) --------------------------
+        self.metrics.gauge(
+            "cko_pipeline_depth",
+            "Configured max batch windows in flight (double buffering)",
+        ).set_function(lambda: float(self.batcher.pipeline_depth))
+        self.metrics.gauge(
+            "cko_inflight_windows",
+            "Batch windows dispatched to device but not yet collected",
+        ).set_function(lambda: float(self.batcher.inflight_windows()))
+        self._m_host_stage = self.metrics.histogram(
+            "cko_host_stage_s",
+            "Host assemble stage per window group (tensorize+tier+dispatch)",
+        )
+        self._m_device_stage = self.metrics.histogram(
+            "cko_device_stage_s",
+            "Device stage per window group (readback block + decode)",
+        )
+        self.batcher.stats.on_stage = self._on_stage
         self._m_ready = self.metrics.gauge(
             "waf_ready", "1 when a compiled ruleset is loaded"
         )
@@ -615,6 +639,10 @@ class TpuEngineSidecar:
         self._m_batches.inc()
         self._m_batch_size.observe(size)
         self._m_step.observe(latency_s)
+
+    def _on_stage(self, host_s: float, device_s: float) -> None:
+        self._m_host_stage.observe(host_s)
+        self._m_device_stage.observe(device_s)
 
     def record_verdict(
         self, request: HttpRequest, verdict: Verdict, tenant: str | None = None
@@ -964,6 +992,10 @@ class TpuEngineSidecar:
     def stats(self) -> dict:
         return {
             "batcher": self.batcher.stats.snapshot(),
+            "pipeline": {
+                "depth": self.batcher.pipeline_depth,
+                "inflight_windows": self.batcher.inflight_windows(),
+            },
             "tenants": self.tenants.stats(),
             "reloads": self.tenants.total_reloads,
             "failed_reloads": self.tenants.total_failed_reloads,

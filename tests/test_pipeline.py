@@ -1,0 +1,466 @@
+"""Pipelined dispatch (ISSUE 4): the prepare/collect split and the
+double-buffered batcher.
+
+Covers the acceptance criteria:
+
+- pipelined verdicts are BIT-IDENTICAL to the synchronous path (and to
+  the host fallback — the same parity harness as degraded mode);
+- FIFO verdict ordering under pipeline depth > 1 (windows collect in
+  dispatch order, never reordered);
+- deadline expiry and breaker-open with windows in flight still produce
+  a verdict for every request;
+- hot reload drains the old engine's in-flight windows (pinned engine,
+  verdicts from the engine that dispatched them);
+- ``WafEngine.prewarm`` covers the pipelined dispatch signature (zero
+  executable-cache misses on the first ``prepare``);
+- ``BatcherStats.snapshot`` nearest-rank percentiles (the old
+  ``int(len * p)`` indexing over-read by one on exact-integer ranks);
+- the new pipeline metrics ride ``/waf/v1/stats`` and ``/metrics``.
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import HttpRequest, WafEngine
+from coraza_kubernetes_operator_tpu.engine.waf import Verdict
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+from coraza_kubernetes_operator_tpu.sidecar.batcher import BatcherStats, MicroBatcher
+from coraza_kubernetes_operator_tpu.testing.overlap import (
+    verdict_tuple as _verdict_tuple,
+)
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+EVIL_MONKEY = (
+    'SecRule ARGS|REQUEST_URI "@contains evilmonkey" '
+    '"id:3001,phase:2,deny,status:403"\n'
+)
+
+
+def _http(port, path, method="GET", body=None, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method, data=body,
+        headers=headers or {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _wait(predicate, timeout_s=60.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# -- BatcherStats percentile fix ----------------------------------------------
+
+
+def test_stats_percentile_nearest_rank():
+    st = BatcherStats()
+    for i in range(1, 5):  # 1..4 ms
+        st.record(1, i / 1e3)
+    snap = st.snapshot()
+    # Nearest rank: p50 of 4 samples is the 2nd (ceil(0.5*4)=2), not the
+    # 3rd the old int(len*p) indexing returned.
+    assert snap["p50_step_ms"] == pytest.approx(2.0)
+    assert snap["p99_step_ms"] == pytest.approx(4.0)
+
+    st = BatcherStats()
+    for i in range(1, 101):  # 1..100 ms
+        st.record(1, i / 1e3)
+    snap = st.snapshot()
+    # p99 of 100 samples is the 99th sample, NOT the max (the old
+    # indexing over-read the p99 bucket and reported the outlier).
+    assert snap["p99_step_ms"] == pytest.approx(99.0)
+    assert snap["p50_step_ms"] == pytest.approx(50.0)
+
+    st = BatcherStats()
+    st.record(1, 0.007)
+    snap = st.snapshot()
+    assert snap["p50_step_ms"] == pytest.approx(7.0)
+    assert snap["p99_step_ms"] == pytest.approx(7.0)
+    assert BatcherStats().snapshot()["p99_step_ms"] == 0.0
+
+
+def test_stats_stage_samples():
+    st = BatcherStats()
+    st.record_stage(0.010, 0.020)
+    st.record_stage(0.030, 0.040)
+    snap = st.snapshot()
+    assert snap["p50_host_stage_ms"] == pytest.approx(10.0)
+    assert snap["p99_host_stage_ms"] == pytest.approx(30.0)
+    assert snap["p99_device_stage_ms"] == pytest.approx(40.0)
+
+
+# -- vectorized decode --------------------------------------------------------
+
+
+def test_matched_id_lists_matches_per_row_loop():
+    from coraza_kubernetes_operator_tpu.models.waf_model import matched_id_lists
+
+    rng = np.random.default_rng(7)
+    n_req, n_rules, n_real = 37, 23, 19
+    matched = rng.random((n_req + 5, n_rules)) < 0.15  # padded rows too
+    rule_ids = rng.integers(1000, 999999, size=n_rules).astype(np.int64)
+    got = matched_id_lists(matched, rule_ids, n_real, n_req)
+    want = [
+        [int(rule_ids[j]) for j in np.flatnonzero(matched[i]) if j < n_real]
+        for i in range(n_req)
+    ]
+    assert got == want
+    assert matched_id_lists(np.zeros((4, 8), bool), rule_ids[:8], 8, 4) == [
+        [] for _ in range(4)
+    ]
+
+
+# -- parity: pipelined == synchronous == host fallback ------------------------
+
+
+def test_pipeline_parity_bit_identical(monkeypatch):
+    """Interleaved prepare/collect at depth 3 produces verdicts
+    bit-identical to the synchronous path and the host fallback."""
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    monkeypatch.setenv("CKO_VALUE_CACHE_MB", "0")
+    from coraza_kubernetes_operator_tpu.corpus import (
+        synthetic_crs,
+        synthetic_requests,
+    )
+
+    eng = WafEngine(synthetic_crs(40, seed=3))
+    batches = [
+        synthetic_requests(48, attack_ratio=0.3, seed=50 + i) for i in range(5)
+    ]
+    sync = [eng.evaluate(reqs) for reqs in batches]
+    # Depth-3 pipeline: three windows in flight before the first collect.
+    inflight = [eng.prepare(reqs) for reqs in batches[:3]]
+    piped = []
+    for nxt in batches[3:]:
+        piped.append(eng.collect(inflight.pop(0)))
+        inflight.append(eng.prepare(nxt))
+    while inflight:
+        piped.append(eng.collect(inflight.pop(0)))
+    for s_batch, p_batch, reqs in zip(sync, piped, batches):
+        assert [_verdict_tuple(a) for a in s_batch] == [
+            _verdict_tuple(b) for b in p_batch
+        ]
+        fb = eng.host_fallback.evaluate(reqs)
+        assert [_verdict_tuple(a) for a in p_batch] == [
+            _verdict_tuple(b) for b in fb
+        ]
+    assert any(v.interrupted for batch in sync for v in batch)
+
+
+def test_prepare_reports_stage_timings(monkeypatch):
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    eng = WafEngine(BASE + EVIL_MONKEY)
+    inf = eng.prepare([HttpRequest(uri="/?pet=evilmonkey")])
+    assert inf.host_s > 0.0
+    verdicts = eng.collect(inf)
+    assert inf.device_s > 0.0
+    assert verdicts[0].interrupted and verdicts[0].rule_id == 3001
+
+
+def test_prepare_body_limit_reject_parity(monkeypatch):
+    """The over-limit 413 pre-pass rides prepare: pipelined and sync
+    paths agree on mixed over/under-limit batches."""
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    rules = BASE + "SecRequestBodyLimit 64\nSecRequestBodyLimitAction Reject\n" + EVIL_MONKEY
+    eng = WafEngine(rules)
+    reqs = [
+        HttpRequest(uri="/ok"),
+        HttpRequest(uri="/big", method="POST", body=b"x" * 200),
+        HttpRequest(uri="/?pet=evilmonkey"),
+    ]
+    sync = eng.evaluate(reqs)
+    piped = eng.collect(eng.prepare(reqs))
+    assert [_verdict_tuple(a) for a in sync] == [_verdict_tuple(b) for b in piped]
+    assert sync[1].status == 413 and sync[2].rule_id == 3001
+
+
+# -- prewarm covers the pipelined dispatch signature --------------------------
+
+
+def test_prewarm_covers_pipelined_path(monkeypatch):
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    monkeypatch.setenv("CKO_VALUE_CACHE_MB", "0")
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import EXEC_CACHE
+
+    eng = WafEngine(BASE + EVIL_MONKEY)
+    batch = [HttpRequest(uri=f"/warm{i}") for i in range(3)]
+    eng.prewarm(batch)
+    misses_before = EXEC_CACHE.snapshot()[1]
+    verdicts = eng.collect(eng.prepare(batch))
+    assert EXEC_CACHE.snapshot()[1] == misses_before  # zero fresh compiles
+    assert len(verdicts) == 3
+
+
+# -- FIFO ordering + overlap under depth > 1 ----------------------------------
+
+
+class _FakeEngine:
+    """Two-stage stub: prepare is instant, collect blocks per-window —
+    the shape of a device step without XLA."""
+
+    def __init__(self, name="A", collect_delay_s=0.0):
+        self.name = name
+        self.collect_delay_s = collect_delay_s
+        self.prepare_times: list[tuple[str, float]] = []
+        self.collected: list[str] = []
+        self.lock = threading.Lock()
+
+    def prepare(self, reqs):
+        with self.lock:
+            self.prepare_times.extend(
+                (r.uri, time.monotonic()) for r in reqs
+            )
+        return types.SimpleNamespace(
+            reqs=reqs,
+            verdicts=[
+                Verdict(
+                    interrupted=False,
+                    status=200,
+                    rule_id=None,
+                    matched_ids=[],
+                    scores={"engine": ord(self.name)},
+                )
+                for _ in reqs
+            ],
+        )
+
+    def collect(self, inflight):
+        if self.collect_delay_s:
+            time.sleep(self.collect_delay_s)
+        with self.lock:
+            self.collected.extend(r.uri for r in inflight.reqs)
+        return inflight.verdicts
+
+
+def test_fifo_ordering_and_overlap_under_depth():
+    eng = _FakeEngine(collect_delay_s=0.15)
+    b = MicroBatcher(
+        lambda: eng, max_batch_size=1, max_batch_delay_ms=0.0, pipeline_depth=3
+    )
+    b.start()
+    done: list[int] = []
+    done_lock = threading.Lock()
+    try:
+        futs = []
+        for i in range(5):
+            fut = b.submit(HttpRequest(uri=f"/w{i}"))
+            fut.add_done_callback(
+                lambda _f, i=i: (done_lock.acquire(), done.append(i), done_lock.release())
+            )
+            futs.append(fut)
+        verdicts = [f.result(timeout=30) for f in futs]
+        assert all(v.status == 200 for v in verdicts)
+        # FIFO: futures resolve in submission order even though three
+        # windows were in flight concurrently.
+        assert done == [0, 1, 2, 3, 4]
+        # Overlap actually happened: window 1's host stage (prepare) ran
+        # BEFORE window 0's device stage (collect) finished.
+        t_prep = dict(eng.prepare_times)
+        assert t_prep["/w1"] < t_prep["/w0"] + eng.collect_delay_s
+        assert eng.collected == [f"/w{i}" for i in range(5)]
+    finally:
+        b.stop()
+
+
+def test_depth_bounds_inflight_windows():
+    eng = _FakeEngine(collect_delay_s=0.2)
+    b = MicroBatcher(
+        lambda: eng, max_batch_size=1, max_batch_delay_ms=0.0, pipeline_depth=2
+    )
+    b.start()
+    try:
+        futs = [b.submit(HttpRequest(uri=f"/d{i}")) for i in range(6)]
+        assert _wait(lambda: b.inflight_windows() > 0, timeout_s=5)
+        peak = 0
+        while not all(f.done() for f in futs):
+            peak = max(peak, b.inflight_windows())
+            assert b.inflight_windows() <= 2
+            time.sleep(0.005)
+        assert peak == 2  # double buffering engaged
+        for f in futs:
+            f.result(timeout=5)
+    finally:
+        b.stop()
+
+
+def test_hot_reload_drains_old_engine_inflight():
+    """A reload mid-flight: the old engine's windows drain to completion
+    on the old engine; new windows dispatch on the new one. No verdict
+    is dropped or re-evaluated."""
+    eng_a = _FakeEngine(name="A", collect_delay_s=0.25)
+    eng_b = _FakeEngine(name="B")
+    current = {"eng": eng_a}
+    b = MicroBatcher(
+        lambda: current["eng"],
+        max_batch_size=1,
+        max_batch_delay_ms=0.0,
+        pipeline_depth=2,
+    )
+    b.start()
+    try:
+        f1 = b.submit(HttpRequest(uri="/old"))
+        assert _wait(lambda: b.inflight_windows() >= 1, timeout_s=5)
+        current["eng"] = eng_b  # hot reload while /old is in flight
+        f2 = b.submit(HttpRequest(uri="/new"))
+        v1 = f1.result(timeout=10)
+        v2 = f2.result(timeout=10)
+        assert v1.scores["engine"] == ord("A")  # pinned to dispatching engine
+        assert v2.scores["engine"] == ord("B")
+        assert eng_a.collected == ["/old"]
+        assert eng_b.collected == ["/new"]
+    finally:
+        b.stop()
+
+
+def test_stop_drains_inflight_windows_deterministically():
+    eng = _FakeEngine(collect_delay_s=0.2)
+    b = MicroBatcher(
+        lambda: eng, max_batch_size=1, max_batch_delay_ms=0.0, pipeline_depth=2
+    )
+    b.start()
+    futs = [b.submit(HttpRequest(uri=f"/s{i}")) for i in range(3)]
+    assert _wait(lambda: b.inflight_windows() >= 1, timeout_s=5)
+    b.stop()
+    # Every future resolved: in-flight windows collected their real
+    # verdicts, still-queued ones failed fast — none abandoned.
+    for f in futs:
+        assert f.done()
+        try:
+            v = f.result(timeout=0)
+            assert v.status == 200
+        except Exception as err:
+            assert "batcher stopped" in str(err)
+
+
+# -- deadline expiry + breaker open with windows in flight --------------------
+
+
+def test_deadline_expiry_with_window_in_flight(monkeypatch):
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    engine = WafEngine(BASE + EVIL_MONKEY)
+    engine.evaluate([HttpRequest(uri="/warm")])  # warm + promote-ready
+    orig_collect = engine.collect
+
+    def slow_collect(inflight):
+        time.sleep(1.5)  # device step that cannot make a 300ms deadline
+        return orig_collect(inflight)
+
+    engine.collect = slow_collect
+    engine.warmed = True
+    sc = TpuEngineSidecar(
+        SidecarConfig(host="127.0.0.1", port=0), engine=engine
+    )
+    sc.start()
+    try:
+        t0 = time.monotonic()
+        status, _, _ = _http(
+            sc.port,
+            "/?pet=evilmonkey",
+            headers={"X-CKO-Deadline-Ms": "300"},
+        )
+        elapsed = time.monotonic() - t0
+        # The fallback answered inside the deadline path with the right
+        # verdict while the pipelined window was still in flight.
+        assert status == 403
+        assert elapsed < 1.5, elapsed
+    finally:
+        sc.stop()  # drains the in-flight window deterministically
+
+
+def test_breaker_opens_with_windows_in_flight(monkeypatch):
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    engine = WafEngine(BASE + EVIL_MONKEY)
+    engine.warmed = True
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1",
+            port=0,
+            breaker_threshold=3,
+            breaker_cooldown_s=300.0,
+            # One window per request: the storm must fail MULTIPLE
+            # windows (several in flight at once under depth 2), not one
+            # coalesced window counting a single breaker failure.
+            max_batch_size=1,
+            max_batch_delay_ms=0.0,
+        ),
+        engine=engine,
+    )
+    sc.start()
+    try:
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "1.0")
+        statuses: list[int] = []
+        lock = threading.Lock()
+
+        def one(i):
+            status, _, _ = _http(sc.port, f"/?pet=evilmonkey&i={i}")
+            with lock:
+                statuses.append(status)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # Every request in the concurrent storm still got the correct
+        # verdict (fallback), and the breaker opened.
+        assert statuses == [403] * 8
+        assert sc.degraded.breaker.state == "open"
+        assert sc.serving_mode() == "broken"
+    finally:
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "0")
+        sc.stop()
+
+
+# -- stats + metrics exposure -------------------------------------------------
+
+
+def test_pipeline_stats_and_metrics_exposed(monkeypatch):
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    engine = WafEngine(BASE + EVIL_MONKEY)
+    sc = TpuEngineSidecar(
+        SidecarConfig(host="127.0.0.1", port=0, pipeline_depth=2), engine=engine
+    )
+    sc.start()
+    try:
+        assert _wait(lambda: sc.serving_mode() == "promoted")
+        status, _, _ = _http(sc.port, "/?pet=evilmonkey")
+        assert status == 403
+        assert _wait(lambda: sc.batcher.stats.host_stage_s, timeout_s=10)
+        _, _, body = _http(sc.port, "/waf/v1/stats")
+        stats = json.loads(body)
+        assert stats["pipeline"]["depth"] == 2
+        assert stats["pipeline"]["inflight_windows"] == 0
+        for key in (
+            "p50_host_stage_ms",
+            "p99_host_stage_ms",
+            "p50_device_stage_ms",
+            "p99_device_stage_ms",
+        ):
+            assert key in stats["batcher"]
+        assert stats["batcher"]["p50_host_stage_ms"] > 0.0
+        _, _, metrics = _http(sc.port, "/waf/v1/metrics")
+        assert b"cko_pipeline_depth 2" in metrics
+        assert b"cko_inflight_windows 0" in metrics
+        assert b"cko_host_stage_s_count" in metrics
+        assert b"cko_device_stage_s_count" in metrics
+    finally:
+        sc.stop()

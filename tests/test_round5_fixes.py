@@ -152,13 +152,17 @@ def test_busy_batcher_extends_wait():
     )
     engine.warmed = True  # strict timeout in force
 
-    real_eval = engine.evaluate
+    # Slow the PREPARE stage: the batcher routes two-stage engines
+    # through prepare()/collect() (a patched evaluate() would never run),
+    # and a mid-stream recompile stalls exactly there — inside the
+    # dispatch thread, with the window open and busy=True.
+    real_prepare = engine.prepare
 
-    def slow_eval(reqs):
+    def slow_prepare(reqs):
         time.sleep(0.5)  # 10x the request timeout, well under compile budget
-        return real_eval(reqs)
+        return real_prepare(reqs)
 
-    engine.evaluate = slow_eval
+    engine.prepare = slow_prepare
     sc.batcher.start()
     try:
         out = sc.evaluate_many(
