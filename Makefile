@@ -77,6 +77,28 @@ presubmit:  ## Gate before any end-of-round snapshot: warm-cache freshness FIRST
 .PHONY: lint
 lint:
 	$(PYTHON) -m compileall -q coraza_kubernetes_operator_tpu tests ftw hack tools
+	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
+		$(PYTHON) -m ruff check coraza_kubernetes_operator_tpu tests ftw hack tools; \
+	else echo "ruff not installed; syntax check only (CI runs the full ruff gate)"; fi
+
+.PHONY: typecheck
+typecheck:  ## mypy gate over seclang/compiler/engine/analysis (config: pyproject.toml).
+	@if $(PYTHON) -m mypy --version >/dev/null 2>&1; then \
+		$(PYTHON) -m mypy; \
+	else echo "mypy not installed (pip install 'mypy==1.11.*'); CI runs the typecheck gate"; fi
+
+# The static-analysis gate (docs/ANALYSIS.md): rulelint over the bundled
+# corpora (zero error-severity findings required) + jaxlint over our own
+# package (any finding fails). Same entrypoint the `analysis` CI job runs.
+.PHONY: analyze
+analyze:  ## Ruleset static analysis + JAX hot-path self-lint.
+	$(PYTHON) -m coraza_kubernetes_operator_tpu.cmd.analyze \
+		ftw/rules ftw/rules/crs-lite --jaxlint
+
+.PHONY: analyze.json
+analyze.json:  ## Same gate, machine-readable (CI uploads this as an artifact).
+	@$(PYTHON) -m coraza_kubernetes_operator_tpu.cmd.analyze \
+		ftw/rules ftw/rules/crs-lite --jaxlint --json
 
 # -- conformance (ftw) --------------------------------------------------------
 

@@ -1051,7 +1051,7 @@ def main() -> None:
             # tunnel's remote_compile endpoint occasionally drops large
             # compiles mid-stream; the second attempt resumes from the
             # persistent XLA cache. Budget is shared across attempts.
-            for attempt in (1, 2):
+            for _attempt in (1, 2):
                 attempt_budget = budget - (time.monotonic() - t0)
                 if attempt_budget <= 10:
                     configs.setdefault(key, {"error": "budget", "budget_s": round(budget, 1)})

@@ -1,0 +1,30 @@
+"""Static analysis over rulesets and over this package itself.
+
+Two prongs (docs/ANALYSIS.md):
+
+- ``rulelint``: semantic analysis of a Seclang document against the
+  compiled IR (AST + ``CompileReport`` + NFA/DFA tables) — ReDoS risk on
+  host-path regexes, shadowed/unreachable rules, dead chain tails,
+  unpopulated variables, duplicate ids, and the TPU-coverage report that
+  turns the compiler's skip log into one enforced number.
+- ``jaxlint``: an AST linter over our own source flagging JAX hot-path
+  hazards (host syncs under jit, tracer branching, wall-clock reads under
+  trace, lock-order inversions in the sidecar threads).
+
+Both run in CI (``make analyze``), at RuleSet admission (the ``Analyzed``
+condition), and at sidecar hot reload (new error-severity findings refuse
+the swap unless ``CKO_ANALYZE_OVERRIDE=1``).
+"""
+
+from .findings import (  # noqa: F401
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARN,
+    AnalysisReport,
+    Finding,
+)
+from .rulelint import (  # noqa: F401
+    analyze_compiled,
+    analyze_document,
+    analyze_ruleset,
+)

@@ -1,0 +1,454 @@
+"""JAX hot-path purity linter over this package's own source (prong 2).
+
+Python-AST based — no imports of the linted code, so it runs in CI in
+milliseconds and can lint broken source. It flags the hazards that turn a
+TPU serving path into a host-synced crawl:
+
+======== =================================================================
+code     hazard
+======== =================================================================
+CKO-J001 implicit host sync under jit: ``.item()`` / ``float()``/``int()``
+         on a traced value, ``np.asarray``/``np.array`` on device values,
+         ``jax.device_get`` / ``.block_until_ready()`` inside a jitted
+         function
+CKO-J002 Python branching (``if``/``while``/``assert``) on a tracer value
+CKO-J003 wall-clock read (``time.time``/``perf_counter``/``monotonic``)
+         inside a jitted function — traces a constant, measures nothing
+CKO-J004 host sync inside a declared no-sync hot path (``prepare`` /
+         ``_dispatch_tiers`` — the pipelined dispatch contract,
+         docs/PIPELINE.md)
+CKO-J005 lock-acquire ordering inversion: two locks acquired in opposite
+         nesting orders across a module's functions (the dispatch /
+         collector thread deadlock class)
+======== =================================================================
+
+Suppression: append ``# jaxlint: ignore`` or ``# jaxlint: ignore[CODE]``
+to the offending line. Functions are considered *jitted* when decorated
+with ``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)`` or passed to
+``jax.jit(...)`` anywhere in the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+
+from .findings import SEV_ERROR, AnalysisReport, Finding
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+# Functions with a no-host-sync contract even though they are not jitted:
+# the pipelined dispatch stage must enqueue and return (any sync here
+# serializes host and device again). Keyed by (filename, function name).
+NO_SYNC_HOT_PATHS = {
+    ("engine/waf.py", "prepare"),
+    ("engine/waf.py", "_dispatch_tiers"),
+}
+
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+_NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+_CAST_FUNCS = {"float", "int", "bool"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Render a Name/Attribute chain as 'a.b.c' ('' when not a chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _Suppressions:
+    def __init__(self, source: str):
+        self._by_line: dict[int, set[str] | None] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "jaxlint:" not in line:
+                continue
+            _, _, directive = line.partition("jaxlint:")
+            directive = directive.strip()
+            if directive.startswith("ignore"):
+                rest = directive[len("ignore"):].strip()
+                if rest.startswith("[") and rest.endswith("]"):
+                    codes = {c.strip() for c in rest[1:-1].split(",") if c.strip()}
+                    self._by_line[i] = codes
+                else:
+                    self._by_line[i] = None  # blanket ignore
+
+    def suppressed(self, line: int, code: str) -> bool:
+        if line not in self._by_line:
+            return False
+        codes = self._by_line[line]
+        return codes is None or code in codes
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    name = _dotted(dec)
+    if name in ("jax.jit", "jit", "pl.pallas_call"):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = _dotted(dec.func)
+        if fname in ("jax.jit", "jit"):
+            return True
+        if fname in ("partial", "functools.partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def _jitted_names(tree: ast.Module) -> set[str]:
+    """Function names passed to jax.jit(...) anywhere in the module body
+    (the `fn = jax.jit(fn)` / `jax.jit(fn, static_argnums=...)` idiom)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in ("jax.jit", "jit"):
+            for arg in node.args[:1]:
+                name = _dotted(arg)
+                if name:
+                    out.add(name.split(".")[-1])
+    return out
+
+
+class _FunctionLinter(ast.NodeVisitor):
+    """Lint one function body under the jit (or no-sync hot path) contract."""
+
+    def __init__(
+        self,
+        rel: str,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        findings: list[Finding],
+        suppress: _Suppressions,
+        jitted: bool,
+    ):
+        self.rel = rel
+        self.fn = fn
+        self.findings = findings
+        self.suppress = suppress
+        self.jitted = jitted
+        # Local names assigned from jnp./lax./jit-call expressions — the
+        # cheap dataflow that lets float()/int()/np.asarray() flags target
+        # device values instead of every cast in the function.
+        self.traced_names: set[str] = set()
+
+    def _emit(self, code: str, node: ast.AST, message: str, detail: str = "") -> None:
+        line = getattr(node, "lineno", self.fn.lineno)
+        if self.suppress.suppressed(line, code):
+            return
+        self.findings.append(
+            Finding(
+                code=code,
+                severity=SEV_ERROR,
+                message=message,
+                location=f"{self.rel}:{line}",
+                detail=detail or f"in {self.fn.name}()",
+            )
+        )
+
+    # -- device-value dataflow ----------------------------------------------
+
+    def _is_device_expr(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func)
+                if name.startswith(("jnp.", "jax.numpy.", "lax.", "jax.lax.")):
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id in self.traced_names:
+                return True
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_device_expr(node.value):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        self.traced_names.add(sub.id)
+        self.generic_visit(node)
+
+    # -- checks ---------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        leaf = name.split(".")[-1] if name else ""
+
+        if leaf in _TIME_FUNCS and name.startswith("time."):
+            if self.jitted:
+                self._emit(
+                    "CKO-J003",
+                    node,
+                    f"wall-clock read {name}() under jit traces a constant",
+                )
+        if leaf in _SYNC_ATTRS and isinstance(node.func, ast.Attribute):
+            code = "CKO-J001" if self.jitted else "CKO-J004"
+            self._emit(
+                code,
+                node,
+                f".{leaf}() forces a host sync"
+                + (" under jit" if self.jitted else " in a no-sync hot path"),
+            )
+        if name in ("jax.device_get",):
+            code = "CKO-J001" if self.jitted else "CKO-J004"
+            self._emit(code, node, "jax.device_get blocks on device readback")
+        if (
+            name.startswith(("np.", "numpy.", "onp."))
+            and leaf in _NP_SYNC_FUNCS
+            and node.args
+            and self._is_device_expr(node.args[0])
+        ):
+            code = "CKO-J001" if self.jitted else "CKO-J004"
+            self._emit(
+                code,
+                node,
+                f"{name}() on a device value copies through the host",
+            )
+        if (
+            self.jitted
+            and name in _CAST_FUNCS
+            and node.args
+            and self._is_device_expr(node.args[0])
+        ):
+            self._emit(
+                "CKO-J001",
+                node,
+                f"{name}() on a traced value forces a host sync under jit",
+            )
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, node: ast.AST, kind: str) -> None:
+        if self.jitted and self._is_device_expr(test):
+            self._emit(
+                "CKO-J002",
+                node,
+                f"Python {kind} on a tracer value (use lax.cond/jnp.where)",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node.test, node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node.test, node, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node.test, node, "assert")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Lock-order analysis (CKO-J005)
+# ---------------------------------------------------------------------------
+
+
+class _LockOrderVisitor(ast.NodeVisitor):
+    """Per-function lock-nesting edges: an edge A -> B is recorded when B
+    is acquired while A is held (``with self._a: ... with self._b`` or
+    ``self._b.acquire()`` under the outer with). One level of
+    intra-class interprocedural closure joins the dispatch/collector
+    split: holding A while calling self.method() that acquires B also
+    yields A -> B."""
+
+    def __init__(self):
+        self.edges: dict[str, set[tuple[str, int]]] = {}
+        self.acquires: dict[str, set[str]] = {}  # function -> locks it takes
+        self.calls: dict[str, set[str]] = {}  # function -> self-methods called
+        self._fn: str | None = None
+        self._held: list[str] = []
+
+    @staticmethod
+    def _lock_name(node: ast.AST) -> str | None:
+        name = _dotted(node)
+        leaf = name.split(".")[-1].lower() if name else ""
+        if any(tag in leaf for tag in ("lock", "sem", "mutex", "cond")):
+            return name
+        return None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        prev, self._fn = self._fn, node.name
+        self.acquires.setdefault(node.name, set())
+        self.calls.setdefault(node.name, set())
+        self.generic_visit(node)
+        self._fn = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _record_acquire(self, lock: str, lineno: int) -> None:
+        if self._fn is None:
+            return
+        self.acquires[self._fn].add(lock)
+        for held in self._held:
+            if held != lock:
+                self.edges.setdefault(held, set()).add((lock, lineno))
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            lock = self._lock_name(item.context_expr)
+            if lock:
+                self._record_acquire(lock, node.lineno)
+                self._held.append(lock)
+                acquired.append(lock)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "acquire":
+                lock = self._lock_name(node.func.value)
+                if lock:
+                    self._record_acquire(lock, node.lineno)
+            else:
+                name = _dotted(node.func)
+                if name.startswith("self.") and self._fn is not None:
+                    self.calls[self._fn].add(name.split(".", 1)[1])
+        self.generic_visit(node)
+
+
+def _lock_order_findings(rel: str, tree: ast.Module, suppress: _Suppressions) -> list[Finding]:
+    visitor = _LockOrderVisitor()
+    visitor.visit(tree)
+
+    # Direct edges, then one interprocedural level: with-blocks that call a
+    # self-method join their held locks to every lock that method takes.
+    edges: dict[str, set[tuple[str, int]]] = {}
+    for key, targets in visitor.edges.items():
+        edges.setdefault(key, set()).update(targets)
+
+    class _HeldCalls(ast.NodeVisitor):
+        def __init__(self):
+            self._held: list[str] = []
+            self.pairs: list[tuple[str, str, int]] = []  # (held, callee, line)
+
+        def visit_With(self, node: ast.With) -> None:
+            acquired = []
+            for item in node.items:
+                lock = _LockOrderVisitor._lock_name(item.context_expr)
+                if lock:
+                    self._held.append(lock)
+                    acquired.append(lock)
+            self.generic_visit(node)
+            for _ in acquired:
+                self._held.pop()
+
+        def visit_Call(self, node: ast.Call) -> None:
+            name = _dotted(node.func)
+            if name.startswith("self.") and self._held:
+                for held in self._held:
+                    self.pairs.append((held, name.split(".", 1)[1], node.lineno))
+            self.generic_visit(node)
+
+    hc = _HeldCalls()
+    hc.visit(tree)
+    for held, callee, lineno in hc.pairs:
+        for lock in visitor.acquires.get(callee, ()):
+            if lock != held:
+                edges.setdefault(held, set()).add((lock, lineno))
+
+    findings: list[Finding] = []
+    # Cycle detection over the lock graph: any A ->* A inversion.
+    names = sorted(edges)
+    seen_cycles: set[frozenset] = set()
+    for start in names:
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt, lineno in edges.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    cyc = frozenset(path)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    if suppress.suppressed(lineno, "CKO-J005"):
+                        continue
+                    findings.append(
+                        Finding(
+                            code="CKO-J005",
+                            severity=SEV_ERROR,
+                            message=(
+                                "lock-order inversion: "
+                                + " -> ".join(path + [start])
+                            ),
+                            location=f"{rel}:{lineno}",
+                            detail=(
+                                "two threads taking these locks in opposite "
+                                "orders can deadlock (dispatch/collector class)"
+                            ),
+                        )
+                    )
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(rel: str, source: str) -> list[Finding]:
+    """Lint one module's source text; ``rel`` is the path used in finding
+    locations (and matched against NO_SYNC_HOT_PATHS)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as err:
+        return [
+            Finding(
+                code="CKO-J000",
+                severity=SEV_ERROR,
+                message=f"syntax error: {err.msg}",
+                location=f"{rel}:{err.lineno or 0}",
+            )
+        ]
+    suppress = _Suppressions(source)
+    jitted_by_call = _jitted_names(tree)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jitted = node.name in jitted_by_call or any(
+            _is_jit_decorator(d) for d in node.decorator_list
+        )
+        tail = "/".join(rel.split("/")[-2:])
+        hot = (rel, node.name) in NO_SYNC_HOT_PATHS or (
+            (tail, node.name) in NO_SYNC_HOT_PATHS
+        )
+        if not (jitted or hot):
+            continue
+        _FunctionLinter(rel, node, findings, suppress, jitted).visit(node)
+    findings.extend(_lock_order_findings(rel, tree, suppress))
+    return findings
+
+
+def lint_paths(paths: list[Path], root: Path | None = None) -> AnalysisReport:
+    report = AnalysisReport()
+    root = root or PACKAGE_ROOT.parent
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        try:
+            rel = str(f.resolve().relative_to(Path(root).resolve()))
+        except ValueError:
+            rel = str(f)
+        rel = rel.replace(os.sep, "/")
+        # Findings key on package-relative paths so the gate's output is
+        # stable no matter where the checkout lives.
+        rel = rel.removeprefix("coraza_kubernetes_operator_tpu/")
+        for finding in lint_source(rel, f.read_text()):
+            report.add(finding)
+    return report.finalize()
+
+
+def lint_package() -> AnalysisReport:
+    """Lint this installed package (the CI gate's target)."""
+    return lint_paths([PACKAGE_ROOT], root=PACKAGE_ROOT)

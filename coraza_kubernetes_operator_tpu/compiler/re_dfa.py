@@ -121,7 +121,7 @@ def _byte_classes(nfa: PositionNFA) -> tuple[np.ndarray, list[int]]:
     return classmap, reps
 
 
-def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object = None) -> DFA:
+def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object | None = None) -> DFA:
     """Subset construction over (position bitmask, prev-byte context).
 
     Position sets are Python big-int bitmasks and every DNF guard is

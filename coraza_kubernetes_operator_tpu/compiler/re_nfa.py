@@ -259,7 +259,7 @@ def build_position_nfa(node: object) -> PositionNFA:
     nfa.entries = entry_targets
     nfa.empty_dnf = empty_dnf
 
-    for state, (pos, after) in builder.char_edges.items():
+    for _state, (pos, after) in builder.char_edges.items():
         targets, accept_dnf = harvest(builder.closure(after))
         if targets:
             nfa.edges[pos] = targets

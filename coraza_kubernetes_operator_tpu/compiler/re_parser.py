@@ -271,8 +271,6 @@ class _Parser:
                     raise self.error("lookbehind not supported (RE2 subset)")
                 while self.next() != ">":
                     pass
-            elif c in ("=", "!"):
-                raise self.error("lookahead not supported (RE2 subset)")
             elif c in "ism-" or c.isalpha():
                 # Inline flags: (?i), (?i:...), (?-i), (?si:...) etc.
                 self.i -= 1
@@ -280,18 +278,14 @@ class _Parser:
                 saw_colon = False
                 while True:
                     f = self.next()
+                    if f in (":", ")"):
+                        saw_colon = f == ":"
+                        break
                     if f == "-":
                         on = False
-                    elif f == ":":
-                        saw_colon = True
-                        break
-                    elif f == ")":
-                        break
                     elif f in "ism":
                         setattr(inner_flags, f, on)
-                    elif f == "U":
-                        pass  # ungreedy — irrelevant for boolean matching
-                    else:
+                    elif f != "U":  # U (ungreedy) is irrelevant here
                         raise self.error(f"unsupported flag {f!r}")
                 if not saw_colon:
                     # (?flags) applies to the rest of the current group; RE2
@@ -300,6 +294,8 @@ class _Parser:
                     flags.i, flags.s, flags.m = inner_flags.i, inner_flags.s, inner_flags.m
                     return REmpty()
             else:
+                if c in ("=", "!"):
+                    raise self.error("lookahead not supported (RE2 subset)")
                 raise self.error(f"unsupported group (?{c}")
         node = self.alternation(inner_flags)
         if not self.eat(")"):

@@ -167,17 +167,43 @@ class CompiledRule:
     ctl_remove_tags: list[str] = field(default_factory=list)
 
 
+def _report_sort_key(entry: tuple[int | None, str]) -> tuple[int, str]:
+    rid, reason = entry
+    return (-1 if rid is None else rid, reason)
+
+
 @dataclass
 class CompileReport:
+    """Skip/approximate ledger. Entries are DEDUPED by ``(rule_id,
+    reason)`` and SORTED at finalize time, so two compiles of the same
+    document always produce byte-identical reports — the analyzer's
+    coverage numbers and the ``cko_rules_skipped_total`` /
+    ``cko_rules_approximated_total`` metrics must not drift between runs
+    (or between the controller's compile and the sidecar's)."""
+
     skipped: list[tuple[int | None, str]] = field(default_factory=list)
     approximations: list[tuple[int | None, str]] = field(default_factory=list)
     const_eliminated: int = 0
 
     def skip(self, rule_id: int | None, reason: str) -> None:
-        self.skipped.append((rule_id, reason))
+        entry = (rule_id, reason)
+        if entry not in self.skipped:
+            self.skipped.append(entry)
 
     def approximate(self, rule_id: int | None, reason: str) -> None:
-        self.approximations.append((rule_id, reason))
+        entry = (rule_id, reason)
+        if entry not in self.approximations:
+            self.approximations.append(entry)
+
+    @property
+    def approximated(self) -> list[tuple[int | None, str]]:
+        """Alias with the metric's name; same deduped, sorted entries."""
+        return self.approximations
+
+    def finalize(self) -> "CompileReport":
+        self.skipped.sort(key=_report_sort_key)
+        self.approximations.sort(key=_report_sort_key)
+        return self
 
 
 @dataclass
@@ -594,7 +620,7 @@ class _Lowering:
             self.report.skip(rule_id, str(e))
             return None
         if plan.approximate:
-            self.report.approximations.append((rule_id, f"@{op.name} approximated"))
+            self.report.approximate(rule_id, f"@{op.name} approximated")
 
         include: list[int] = []
         exclude: list[int] = []
@@ -1032,6 +1058,7 @@ class _Lowering:
 
         return CompiledRuleSet(
             program=self.program,
+            report=self.report.finalize(),
             groups=self.groups,
             rules=self.rules,
             links=self.links,
@@ -1043,7 +1070,6 @@ class _Lowering:
             pipelines=pipelines,
             pipeline_device=pipeline_device,
             group_pipeline=group_pipeline,
-            report=self.report,
             engine_mode=self.program.engine_mode,
         )
 

@@ -149,6 +149,7 @@ def _scan_data(s: str, i: int) -> bool:
         if res is False:
             return False
         i = res  # resumed data position
+    return False
 
 
 def _scan_in_tag(s: str, i: int):

@@ -111,6 +111,18 @@ class RuleSetSpec:
 
 @dataclass
 class RuleSetStatus:
+    """Condition types (tri-state machine in ``conditions.py``):
+
+    - ``Ready``: rules parsed, compiled for the TPU engine, and cached.
+    - ``Progressing`` / ``Degraded``: reconcile in flight / failed.
+    - ``Analyzed``: static-analysis verdict for the aggregated document
+      (docs/ANALYSIS.md). True ⇒ zero error-severity findings; False ⇒
+      reason ``ErrorFindings`` (counts in the message — the sidecar's
+      reload gate will refuse a swap that introduces new ones) or
+      ``AnalysisError`` (the analyzer itself crashed). Advisory: it never
+      blocks Ready, so a flagged ruleset still serves while the operator
+      decides."""
+
     conditions: list[Condition] = field(default_factory=list)
 
 

@@ -56,6 +56,17 @@ def set_status_degraded(conditions: list[Condition], generation: int, reason: st
     _remove(conditions, "Progressing")
 
 
+def set_status_analyzed(
+    conditions: list[Condition], generation: int, reason: str, message: str, ok: bool
+) -> None:
+    """``Analyzed`` rides alongside Ready/Progressing/Degraded rather than
+    through the tri-state machine: analysis findings are advisory at
+    admission (the sidecar reload gate is the enforcement point), so a
+    ruleset with error findings can still be Ready while Analyzed=False
+    tells the operator why the data plane may refuse the next reload."""
+    _set(conditions, _cond("Analyzed", ok, generation, reason, message))
+
+
 def get_condition(conditions: list[Condition], cond_type: str) -> Condition | None:
     for c in conditions:
         if c.type == cond_type:

@@ -23,7 +23,12 @@ from ..compiler.ruleset import CompileError, compile_rules
 from ..seclang import SeclangParseError, parse
 from ..utils import get_logger
 from .api_types import RuleSet, VALIDATION_ANNOTATION
-from .conditions import set_status_degraded, set_status_progressing, set_status_ready
+from .conditions import (
+    set_status_analyzed,
+    set_status_degraded,
+    set_status_progressing,
+    set_status_ready,
+)
 from .events import EventRecorder
 from .store import ObjectStore
 
@@ -99,13 +104,20 @@ class RuleSetReconciler:
         # Beyond the reference: prove the merged document lowers to device
         # tables, so Ready ⇒ servable by the TPU engine.
         try:
-            compile_rules(aggregated)
+            compiled = compile_rules(aggregated)
         except (SeclangParseError, CompileError, ValueError) as err:
             degraded(
                 "InvalidRuleSet",
                 f"Aggregated rules do not compile for the TPU engine:\n{err}",
             )
             raise ReconcileError(str(err)) from err
+
+        # Admission-time static analysis (docs/ANALYSIS.md): reuse the
+        # compiled IR, surface finding counts on the Analyzed condition.
+        # Advisory here — error findings do not block caching (the sidecar
+        # reload gate enforces), but the operator sees them *before* the
+        # data plane refuses the swap at 3am.
+        self._analyze(ruleset, generation, aggregated, compiled)
 
         cache_key = f"{namespace}/{name}"
         self.cache.put(cache_key, aggregated)
@@ -116,6 +128,39 @@ class RuleSetReconciler:
         set_status_ready(ruleset.status.conditions, generation, "RulesCached", msg)
         self.store.update_status(ruleset)
         return ReconcileResult()
+
+    def _analyze(self, ruleset: RuleSet, generation: int, text: str, compiled) -> None:
+        """Run rulelint over the aggregated document and record the result
+        as the ``Analyzed`` condition + an event. Analyzer crashes degrade
+        to Analyzed=False/AnalysisError — never a reconcile failure."""
+        try:
+            from ..analysis.rulelint import analyze_document
+
+            report = analyze_document(text, compiled)
+        except Exception as err:
+            set_status_analyzed(
+                ruleset.status.conditions,
+                generation,
+                "AnalysisError",
+                f"Static analysis crashed: {err}",
+                ok=False,
+            )
+            return
+        counts = report.counts()
+        cov = report.coverage.get("coverage_pct", 0.0)
+        msg = (
+            f"{counts['error']} error(s), {counts['warn']} warning(s), "
+            f"{counts['info']} info; {cov:.1f}% of rules on-device"
+        )
+        if counts["error"]:
+            self.recorder.event(ruleset, "Warning", "AnalysisFindings", msg)
+            set_status_analyzed(
+                ruleset.status.conditions, generation, "ErrorFindings", msg, ok=False
+            )
+        else:
+            set_status_analyzed(
+                ruleset.status.conditions, generation, "RulesAnalyzed", msg, ok=True
+            )
 
 
 def find_rulesets_for_configmap(store: ObjectStore, cm) -> list[tuple[str, str]]:

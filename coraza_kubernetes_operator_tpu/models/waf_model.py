@@ -455,7 +455,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         block_kinds.append(tuple(sorted(ks)))
     for seg in segs:
         block_cost.append(float(conv_n2_cols(seg.spec)))
-    for (pid, _bucket), gids in sorted(buckets.items()):
+    for (_pid, _bucket), gids in sorted(buckets.items()):
         ks = set()
         for gid in gids:
             ks |= gkind_sets[gid]

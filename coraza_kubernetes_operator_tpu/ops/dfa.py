@@ -239,8 +239,7 @@ def scan_dfa_bank_take(
     end_sigma = end_state[:, None, :] == state_iota  # [B, S, G]
     end_match = jnp.any(end_sigma & bank.match_end.T[None, :, :], axis=1)
     matched = matched | end_match
-    matched = matched | bank.always[None, :]
-    return matched
+    return matched | bank.always[None, :]
 
 
 @partial(jax.jit, static_argnames=())
@@ -276,5 +275,4 @@ def scan_dfa_bank_gather(
         step, init, jnp.arange(data.shape[1], dtype=jnp.int32)
     )
     matched = matched | bank.match_end[garange, end_state]
-    matched = matched | bank.always[None, :]
-    return matched
+    return matched | bank.always[None, :]

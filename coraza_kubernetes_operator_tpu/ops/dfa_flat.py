@@ -211,7 +211,7 @@ def plan_flat_bins(
     to exclude whole pipelines, so a mask usually skips or keeps a whole
     bin, and stitching stays order-simple."""
     rejected: set[int] = set()
-    for block_idx, pid, dfas in bank_dfas:
+    for block_idx, _pid, dfas in bank_dfas:
         for d in dfas:
             if (
                 flat_vmem_bytes(

@@ -464,7 +464,7 @@ def match_segment_block(
     #     signature-bucketed batched program of v2.
     old_path: list[int] = []
     chain_first: list[int] = []
-    for bi, (gid, prog, a_start, a_end) in enumerate(spec.branches):
+    for bi, (_gid, prog, _a_start, _a_end) in enumerate(spec.branches):
         if len(prog) >= 2 and prog[0][0] == "seg":
             chain_first.append(bi)
         else:
@@ -788,7 +788,7 @@ def match_segment_block(
             col_groups.extend(spec.branches[bi][0] for bi in idxs)
         iota2 = iota  # [1, Q]
         gj_per_group: list[jnp.ndarray] = []
-        for (sid, n_lead, n_real, a_start), items in finals.items():
+        for (sid, n_lead, n_real, a_start), _items in finals.items():
             s2 = s_store[sid]  # [T, Q], indexed by real start of the NEXT element
             g = (
                 (iota2 >= 1)

@@ -15,19 +15,32 @@ configures into coraza-proxy-wasm (pluginConfig keys
 
 Compile failures keep the previous engine serving (the WASM plugin behaves
 the same way: last-loaded rules keep running).
+
+Reload analysis gate (docs/ANALYSIS.md): every successfully compiled
+reload is statically analyzed (``analysis.rulelint``) against the ruleset
+currently serving. A reload that introduces NEW error-severity findings —
+a ReDoS-prone host-path pattern, a duplicate id, a parse regression in an
+included file — is refused and the previous engine keeps serving, unless
+``CKO_ANALYZE_OVERRIDE=1`` is set. The first load is never gated (there
+is no previous ruleset to keep serving; admission-time analysis is the
+controller's job) and an analyzer *crash* never blocks a reload.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.error
 import urllib.request
 
+from ..analysis.findings import AnalysisReport
 from ..engine.waf import WafEngine
 from ..utils import get_logger
 
 log = get_logger("sidecar.reloader")
+
+ANALYZE_OVERRIDE_ENV = "CKO_ANALYZE_OVERRIDE"
 
 DEFAULT_POLL_INTERVAL_S = 15.0
 # Failure backoff: after a failed poll the next attempt comes quickly and
@@ -69,6 +82,14 @@ class RuleReloader:
         # retry backoff.
         self.poll_failures = 0
         self.consecutive_poll_failures = 0
+        # Static-analysis reload gate: the serving ruleset's report (None
+        # until first analyzed) and how many reloads the gate refused.
+        # The refused uuid is latched so a rejected document is not
+        # re-fetched/re-compiled/re-analyzed every poll interval; setting
+        # the override env or publishing a new version unlatches.
+        self.analysis: AnalysisReport | None = None
+        self.analyze_rejected = 0
+        self._rejected_uuid: str | None = None
 
     # -- public --------------------------------------------------------------
 
@@ -133,6 +154,8 @@ class RuleReloader:
         uuid = latest.get("uuid")
         if not uuid or uuid == self._uuid:
             return False
+        if uuid == self._rejected_uuid and os.environ.get(ANALYZE_OVERRIDE_ENV) != "1":
+            return False  # already refused by the analysis gate; don't re-compile
         try:
             entry = self._get_json(f"/rules/{self.instance_key}")
         except (urllib.error.URLError, ValueError, OSError) as err:
@@ -146,6 +169,18 @@ class RuleReloader:
             self.failed_reloads += 1
             log.error("rule compile failed; keeping previous ruleset", err, uuid=uuid)
             return False
+        report = self._analyze(rules, engine)
+        if not self._admit(report, uuid):
+            self.failed_reloads += 1
+            self.analyze_rejected += 1
+            self._rejected_uuid = uuid
+            return False
+        if report is not None:
+            self.analysis = report
+        # else: analyzer crashed — keep the previous baseline so the next
+        # reload still compares against real findings (an empty baseline
+        # would read every pre-existing error as "new" and refuse a fix).
+        self._rejected_uuid = None
         self._engine = engine  # atomic swap; next batch window uses it
         self._uuid = uuid
         self.reloads += 1
@@ -165,6 +200,46 @@ class RuleReloader:
         return True
 
     # -- internals -----------------------------------------------------------
+
+    def _analyze(self, rules: str, engine: WafEngine) -> AnalysisReport | None:
+        """Static analysis of a freshly compiled ruleset, reusing the
+        engine's compiled IR (no second compile). An analyzer crash must
+        never block a reload — it degrades to 'not analyzed' (None)."""
+        try:
+            from ..analysis.rulelint import analyze_document
+
+            return analyze_document(rules, engine.compiled)
+        except Exception as err:
+            log.error("ruleset analysis failed; reload not gated", err)
+            return None
+
+    def _admit(self, report: AnalysisReport | None, uuid: str | None) -> bool:
+        """The reload gate: refuse a swap that introduces NEW error-severity
+        findings relative to the serving ruleset (docs/ANALYSIS.md). First
+        load (nothing serving yet) and analyzer failures always admit;
+        ``CKO_ANALYZE_OVERRIDE=1`` overrides a refusal."""
+        if report is None or self._engine is None:
+            return True
+        previous = self.analysis.error_keys() if self.analysis is not None else set()
+        new_errors = [f for f in report.errors if f.key not in previous]
+        if not new_errors:
+            return True
+        if os.environ.get(ANALYZE_OVERRIDE_ENV) == "1":
+            log.info(
+                "reload has new error findings; admitted by override",
+                key=self.instance_key,
+                uuid=uuid,
+                errors=len(new_errors),
+            )
+            return True
+        log.error(
+            "reload refused: new error-severity analysis findings "
+            f"(set {ANALYZE_OVERRIDE_ENV}=1 to override)",
+            key=self.instance_key,
+            uuid=uuid,
+            findings=[f.render() for f in new_errors[:5]],
+        )
+        return False
 
     def _get_json(self, path: str) -> dict:
         from ..testing.faults import maybe_cache_outage

@@ -613,6 +613,35 @@ class TpuEngineSidecar:
             "cko_engine_dedup_total",
             "Tenant engines deduped onto a resident same-ruleset engine",
         ).set_function(lambda: float(self.tenants.engine_dedup_hits))
+        # -- static analysis (docs/ANALYSIS.md) -----------------------------
+        # Findings against the currently serving rulesets (all tenants),
+        # refreshed on every reload; the reload gate refuses swaps that
+        # introduce new error-severity findings.
+        m_findings = self.metrics.gauge(
+            "cko_analysis_findings_total",
+            "Static-analysis findings against the serving rulesets",
+            ("severity",),
+        )
+        for sev in ("error", "warn", "info"):
+            m_findings.set_function(
+                (lambda s: lambda: float(self.tenants.analysis_counts()[s]))(sev),
+                severity=sev,
+            )
+        self.metrics.gauge(
+            "cko_analyze_rejected_total",
+            "Hot reloads refused by the analysis gate (new error findings)",
+        ).set_function(lambda: float(self.tenants.total_analyze_rejected))
+        # Deterministic CompileReport ledger for the default tenant's
+        # serving ruleset: how many rules the compiler skipped off the
+        # device plan / approximated (the TPU-coverage numbers).
+        self.metrics.gauge(
+            "cko_rules_skipped_total",
+            "Rules skipped from the device plan (default tenant)",
+        ).set_function(lambda: float(self._compile_report_len("skipped")))
+        self.metrics.gauge(
+            "cko_rules_approximated_total",
+            "Rules approximated in the device plan (default tenant)",
+        ).set_function(lambda: float(self._compile_report_len("approximated")))
         self.batcher.on_engine_error = (
             lambda _engine, err: self.degraded.record_device_failure(err)
         )
@@ -989,6 +1018,12 @@ class TpuEngineSidecar:
                     raise
         return out
 
+    def _compile_report_len(self, field: str) -> int:
+        engine = self.tenants.engine_for(None)
+        if engine is None:
+            return 0
+        return len(getattr(engine.compiled.report, field))
+
     def stats(self) -> dict:
         return {
             "batcher": self.batcher.stats.snapshot(),
@@ -1008,6 +1043,12 @@ class TpuEngineSidecar:
             "compile_cache": _exec_cache_stats(),
             "resident_engines": self.tenants.resident_engines(),
             "engine_dedup_hits": self.tenants.engine_dedup_hits,
+            "analysis": {
+                "cko_analysis_findings_total": self.tenants.analysis_counts(),
+                "rejected_reloads": self.tenants.total_analyze_rejected,
+            },
+            "cko_rules_skipped_total": self._compile_report_len("skipped"),
+            "cko_rules_approximated_total": self._compile_report_len("approximated"),
         }
 
     # -- lifecycle -----------------------------------------------------------

@@ -164,9 +164,30 @@ class TenantManager:
                 "failed_reloads": r.failed_reloads,
                 "poll_failures": r.poll_failures,
                 "loaded": r.engine is not None,
+                "analyze_rejected": r.analyze_rejected,
+                "analysis": (
+                    r.analysis.counts() if r.analysis is not None else None
+                ),
             }
             for key, r in reloaders.items()
         }
+
+    def analysis_counts(self) -> dict[str, int]:
+        """Finding counts by severity summed across tenants' serving
+        rulesets (the cko_analysis_findings_total metric)."""
+        out = {"error": 0, "warn": 0, "info": 0}
+        with self._lock:
+            reloaders = list(self._reloaders.values())
+        for r in reloaders:
+            if r.analysis is not None:
+                for sev, n in r.analysis.counts().items():
+                    out[sev] = out.get(sev, 0) + n
+        return out
+
+    @property
+    def total_analyze_rejected(self) -> int:
+        with self._lock:
+            return sum(r.analyze_rejected for r in self._reloaders.values())
 
     @property
     def total_reloads(self) -> int:

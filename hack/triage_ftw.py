@@ -7,6 +7,12 @@ corpus-authoring vs ledger: matched rule ids, per-counter anomaly scores,
 the stage's expectations, and the verdict. The analog of reading the
 reference's go-ftw output next to its ftw/ftw.yml ledger.
 
+Each failure is also joined against the static analyzer's findings for
+the same rule id (docs/ANALYSIS.md): a test failing on a rule the
+analyzer already flagged as skipped/shadowed/dead is a compiler-coverage
+problem, not an engine bug — the join says which bucket to triage into
+before reading a single request dump.
+
 Usage: python hack/triage_ftw.py [test-prefix ...]
 """
 
@@ -19,30 +25,63 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
+from coraza_kubernetes_operator_tpu.analysis.rulelint import analyze_compiled
 from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
 from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
 from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
 from coraza_kubernetes_operator_tpu.ftw.loader import load_tests_report
 from coraza_kubernetes_operator_tpu.ftw.runner import FtwRunner, _stage_request
+from coraza_kubernetes_operator_tpu.seclang.parser import parse
 
 CORPUS = REPO / "ftw" / "tests-crs-lite"
 
 
+def _rule_ids_for_test(test) -> set[int]:
+    """Rule ids a test is about: its numeric title prefix (go-ftw corpus
+    convention: 942100-1 exercises rule 942100) plus every expected id."""
+    ids: set[int] = set()
+    head = test.title.split("-", 1)[0]
+    if head.isdigit():
+        ids.add(int(head))
+    for stage in test.stages:
+        ids.update(stage.expect_ids)
+        ids.update(stage.no_expect_ids)
+    return ids
+
+
 def main() -> None:
     prefixes = tuple(sys.argv[1:])
-    crs = compile_rules(load_ruleset_text())
+    text = load_ruleset_text()
+    crs = compile_rules(text)
     engine = WafEngine(crs)
     runner = FtwRunner(engine=engine)
     tests, skipped = load_tests_report(CORPUS)
     result = runner.run(tests)
     print(json.dumps(result.summary(), indent=2))
 
+    # Analyzer join: one rulelint pass over the same compiled IR, indexed
+    # by rule id, so each failure shows what the analyzer already knew.
+    analysis = analyze_compiled(parse(text), crs)
+    findings_by_id: dict[int, list] = {}
+    for f in analysis.findings:
+        if f.rule_id is not None:
+            findings_by_id.setdefault(f.rule_id, []).append(f)
+
+    flagged = 0
     meta = engine.rule_meta
     for title, reason in sorted(result.failed.items()):
         if prefixes and not title.startswith(prefixes):
             continue
         test = next(t for t in tests if t.title == title)
         print(f"\n=== {title}: {reason}")
+        joined = [
+            f for rid in sorted(_rule_ids_for_test(test))
+            for f in findings_by_id.get(rid, ())
+        ]
+        if joined:
+            flagged += 1
+            for f in joined:
+                print(f"  analyzer: {f.render()}")
         for i, stage in enumerate(test.stages):
             req = _stage_request(stage)
             verdict = engine.evaluate_one(req)
@@ -62,6 +101,12 @@ def main() -> None:
             )
             nz = {k: v for k, v in verdict.scores.items() if v}
             print(f"    scores: {nz}")
+
+    if result.failed:
+        print(
+            f"\n{flagged}/{len(result.failed)} failures touch a rule the "
+            "analyzer flagged (see 'analyzer:' lines above)"
+        )
 
 
 if __name__ == "__main__":

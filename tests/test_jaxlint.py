@@ -1,0 +1,348 @@
+"""jaxlint (analysis prong 2): seeded violations in fixture source are
+caught, suppressions work, and the real package is clean.
+
+Every check lints SOURCE STRINGS through ``lint_source`` — no imports of
+the linted code — so fixtures exercise exactly the AST patterns the CI
+gate guards against (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from coraza_kubernetes_operator_tpu.analysis.jaxlint import (
+    lint_package,
+    lint_source,
+)
+
+
+def _codes(src: str, rel: str = "ops/fixture.py") -> list[str]:
+    return [f.code for f in lint_source(rel, textwrap.dedent(src))]
+
+
+# ---------------------------------------------------------------------------
+# CKO-J001: implicit host syncs under jit
+# ---------------------------------------------------------------------------
+
+
+def test_item_under_jit_flagged():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_float_cast_on_traced_value_flagged():
+    src = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        y = jnp.sum(x)
+        return float(y)
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_np_asarray_on_device_value_flagged():
+    src = """
+    import jax, numpy as np, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        y = jnp.abs(x)
+        return np.asarray(y)
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_device_get_under_jit_flagged():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return jax.device_get(x)
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_jit_by_call_assignment_detected():
+    # The `g = jax.jit(g)` idiom must count as jitted too.
+    src = """
+    import jax
+
+    def g(x):
+        return x.item()
+
+    g = jax.jit(g)
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_clean_jitted_function_not_flagged():
+    src = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(x) + 1
+    """
+    assert _codes(src) == []
+
+
+def test_unjitted_function_not_flagged():
+    # float()/.item() on host values outside jit is normal Python.
+    src = """
+    def f(x):
+        return float(x.item())
+    """
+    assert _codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-J002: Python branching on tracer values
+# ---------------------------------------------------------------------------
+
+
+def test_if_on_tracer_flagged():
+    src = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        y = jnp.max(x)
+        if y > 0:
+            return x
+        return -x
+    """
+    assert "CKO-J002" in _codes(src)
+
+
+def test_while_on_tracer_flagged():
+    src = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        s = jnp.sum(x)
+        while s > 0:
+            s = s - 1
+        return s
+    """
+    assert "CKO-J002" in _codes(src)
+
+
+def test_if_on_python_value_not_flagged():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x, n: int):
+        if n > 3:
+            return x
+        return -x
+    """
+    assert "CKO-J002" not in _codes(src)
+
+
+# ---------------------------------------------------------------------------
+# CKO-J003: wall-clock reads under jit
+# ---------------------------------------------------------------------------
+
+
+def test_time_time_under_jit_flagged():
+    src = """
+    import jax, time
+
+    @jax.jit
+    def f(x):
+        t0 = time.time()
+        return x, t0
+    """
+    assert "CKO-J003" in _codes(src)
+
+
+def test_time_time_outside_jit_not_flagged():
+    src = """
+    import time
+
+    def f(x):
+        return time.perf_counter()
+    """
+    assert "CKO-J003" not in _codes(src)
+
+
+# ---------------------------------------------------------------------------
+# CKO-J004: syncs inside declared no-sync hot paths (engine/waf.py
+# prepare/_dispatch_tiers — the pipelined dispatch contract)
+# ---------------------------------------------------------------------------
+
+
+def test_no_sync_hot_path_flagged_by_rel_path():
+    src = """
+    def prepare(self, requests):
+        return self._tensors.block_until_ready()
+    """
+    assert "CKO-J004" in _codes(src, rel="engine/waf.py")
+
+
+def test_same_function_name_elsewhere_not_hot():
+    src = """
+    def prepare(self, requests):
+        return self._tensors.block_until_ready()
+    """
+    assert _codes(src, rel="engine/other.py") == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-J005: lock-order inversions
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_inversion_flagged():
+    src = """
+    class Batcher:
+        def dispatch(self):
+            with self._queue_lock:
+                with self._window_lock:
+                    pass
+
+        def collect(self):
+            with self._window_lock:
+                with self._queue_lock:
+                    pass
+    """
+    assert "CKO-J005" in _codes(src, rel="sidecar/fixture.py")
+
+
+def test_consistent_lock_order_not_flagged():
+    src = """
+    class Batcher:
+        def dispatch(self):
+            with self._queue_lock:
+                with self._window_lock:
+                    pass
+
+        def collect(self):
+            with self._queue_lock:
+                with self._window_lock:
+                    pass
+    """
+    assert _codes(src, rel="sidecar/fixture.py") == []
+
+
+def test_interprocedural_inversion_flagged():
+    # Holding A while calling a method that takes B, against a B->A order
+    # elsewhere: the dispatch/collector deadlock class.
+    src = """
+    class Batcher:
+        def dispatch(self):
+            with self._queue_lock:
+                self._grow()
+
+        def _grow(self):
+            with self._window_lock:
+                pass
+
+        def collect(self):
+            with self._window_lock:
+                with self._queue_lock:
+                    pass
+    """
+    assert "CKO-J005" in _codes(src, rel="sidecar/fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# Suppressions + syntax errors
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comment_blanket():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # jaxlint: ignore
+    """
+    assert _codes(src) == []
+
+
+def test_suppression_comment_by_code():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # jaxlint: ignore[CKO-J001]
+    """
+    assert _codes(src) == []
+
+
+def test_suppression_wrong_code_still_flags():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # jaxlint: ignore[CKO-J999]
+    """
+    assert "CKO-J001" in _codes(src)
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = lint_source("ops/broken.py", "def f(:\n")
+    assert [f.code for f in findings] == ["CKO-J000"]
+
+
+# ---------------------------------------------------------------------------
+# The real package: clean, and the linter is actually looking at something
+# ---------------------------------------------------------------------------
+
+
+def test_package_is_clean():
+    report = lint_package()
+    assert report.findings == [], "\n" + report.render()
+
+
+def test_package_detection_coverage():
+    """A linter that finds no jitted functions is trivially 'clean'.
+    Prove the real package presents a non-trivial lint surface: jitted
+    functions exist in ops/ and the declared no-sync hot paths resolve to
+    real functions in engine/waf.py."""
+    import ast
+    from pathlib import Path
+
+    from coraza_kubernetes_operator_tpu.analysis.jaxlint import (
+        NO_SYNC_HOT_PATHS,
+        PACKAGE_ROOT,
+        _is_jit_decorator,
+        _jitted_names,
+    )
+
+    jitted = 0
+    for path in sorted(PACKAGE_ROOT.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        tree = ast.parse(path.read_text())
+        by_call = _jitted_names(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                node.name in by_call
+                or any(_is_jit_decorator(d) for d in node.decorator_list)
+            ):
+                jitted += 1
+    assert jitted >= 5, f"only {jitted} jitted functions found — linter blind?"
+
+    waf = ast.parse((Path(PACKAGE_ROOT) / "engine" / "waf.py").read_text())
+    names = {
+        n.name for n in ast.walk(waf)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for rel, fn in NO_SYNC_HOT_PATHS:
+        assert fn in names, f"hot path {rel}:{fn} no longer exists"

@@ -51,7 +51,7 @@ def _random_requests(n: int, seed: int) -> list[HttpRequest]:
     rng = random.Random(seed)
     alphabet = string.printable + "\x00\xe9\xff%&=+;"
     reqs = []
-    for i in range(n):
+    for _i in range(n):
         kind = rng.randrange(6)
         headers = [("Host", "test.local"), ("User-Agent", rng.choice(
             ["Mozilla/5.0", "sqlmap/1.7", "curl/8", "NIKTO scan"]))]

@@ -5,6 +5,7 @@ cache GC flags, health endpoints — exercised here through the Python
 entrypoint with the file-based object source.
 """
 
+import argparse
 import json
 import threading
 import time
@@ -69,7 +70,7 @@ def test_parse_duration():
     assert parse_duration("5m").total_seconds() == 300
     assert parse_duration("24h").total_seconds() == 86400
     assert parse_duration("1h30m").total_seconds() == 5400
-    with pytest.raises(Exception):
+    with pytest.raises(argparse.ArgumentTypeError):
         parse_duration("nope")
 
 

@@ -1,0 +1,545 @@
+"""rulelint (analysis prong 1): every finding class has a minimal
+positive + negative fixture, the EDA decision procedure is exercised on
+known-pathological patterns, CompileReport is deterministic, the CRS-lite
+corpus analyzes with zero errors (snapshot of warn counts), and the
+analysis gate is wired end to end: controller ``Analyzed`` condition,
+sidecar hot-reload refusal + ``CKO_ANALYZE_OVERRIDE=1``, and the
+``cko_analysis_findings_total`` exposure in ``/waf/v1/stats``."""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.analysis.findings import AnalysisReport
+from coraza_kubernetes_operator_tpu.analysis.redos import (
+    ast_has_nullable_loop,
+    pattern_has_eda,
+)
+from coraza_kubernetes_operator_tpu.analysis.rulelint import (
+    analyze_compiled,
+    analyze_ruleset,
+    duplicate_id_findings,
+)
+from coraza_kubernetes_operator_tpu.compiler.re_parser import parse_regex
+from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+"""
+
+
+def _codes(doc: str) -> list[str]:
+    return [f.code for f in analyze_ruleset(BASE + doc).findings]
+
+
+def _find(doc: str, code: str):
+    return [f for f in analyze_ruleset(BASE + doc).findings if f.code == code]
+
+
+# ---------------------------------------------------------------------------
+# CKO-R001: duplicate rule ids (detected pre-parse from the raw document)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_ids_flagged():
+    doc = BASE + (
+        'SecRule ARGS "@rx foo" "id:200,phase:2,deny,status:403"\n'
+        'SecRule ARGS "@rx bar" "id:200,phase:2,deny,status:403"\n'
+    )
+    dups = duplicate_id_findings(doc)
+    assert [f.code for f in dups] == ["CKO-R001"]
+    assert dups[0].rule_id == 200
+    # analyze_ruleset surfaces both the duplicate and the parse refusal.
+    codes = [f.code for f in analyze_ruleset(doc).findings]
+    assert "CKO-R001" in codes
+
+
+def test_distinct_ids_not_flagged():
+    doc = BASE + (
+        'SecRule ARGS "@rx foo" "id:200,phase:2,deny,status:403"\n'
+        'SecRule ARGS "@rx bar" "id:201,phase:2,deny,status:403"\n'
+    )
+    assert duplicate_id_findings(doc) == []
+
+
+def test_commented_out_rule_is_not_a_duplicate():
+    # A commented-out old copy of a rule must not read as a collision
+    # (the document parses and compiles; an error here would make the
+    # reload gate refuse a perfectly valid ruleset).
+    doc = BASE + (
+        '# SecRule ARGS "@rx old" "id:200,phase:2,deny,status:403"\n'
+        'SecRule ARGS "@rx new" "id:200,phase:2,deny,status:403"\n'
+    )
+    assert duplicate_id_findings(doc) == []
+    assert analyze_ruleset(doc).errors == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-R002 / CKO-R003: ReDoS risk, decided on the compiled NFA
+# ---------------------------------------------------------------------------
+
+
+def test_host_path_eda_pattern_is_error():
+    # TX string match is unsupported on-device, so the rule is skipped —
+    # its ambiguous pattern would run under a backtracking engine.
+    doc = 'SecRule TX:blocked "@rx (a+)+$" "id:100,phase:2,t:none,deny,status:403"\n'
+    hits = _find(doc, "CKO-R002")
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert hits[0].rule_id == 100
+
+
+def test_device_eda_pattern_is_info_not_error():
+    doc = 'SecRule ARGS "@rx (a+)+$" "id:101,phase:2,t:none,deny,status:403"\n'
+    codes = _codes(doc)
+    assert "CKO-R002" not in codes
+    assert "CKO-R003" in codes
+
+
+def test_unambiguous_host_path_pattern_not_flagged():
+    doc = 'SecRule TX:blocked "@rx hello" "id:102,phase:2,t:none,deny,status:403"\n'
+    codes = _codes(doc)
+    assert "CKO-R002" not in codes and "CKO-R003" not in codes
+
+
+# ---------------------------------------------------------------------------
+# EDA decision procedure (analysis/redos.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "pattern,verdict",
+    [
+        ("(a+)+$", True),  # classic nested quantifier
+        ("(a|a)*", True),  # ambiguous alternation under star
+        ("(a*)*", True),  # nullable loop (ε-ambiguity, AST-decided)
+        ("(a?)+", True),
+        ("a+", False),
+        ("(ab|ba)*", False),
+        ("(a|b)+", False),  # disjoint branches: no ambiguity
+        ("[a-z]+@[a-z]+", False),
+        ("(?i)union\\s+select", False),
+    ],
+)
+def test_eda_verdicts(pattern, verdict):
+    assert pattern_has_eda(pattern) is verdict
+
+
+def test_eda_unparseable_pattern_is_none():
+    assert pattern_has_eda("(?!lookahead)x") is None
+
+
+def test_nullable_loop_detected_on_ast():
+    assert ast_has_nullable_loop(parse_regex("(a*)*")) is True
+    assert ast_has_nullable_loop(parse_regex("(a+)+")) is False  # NFA's job
+
+
+def test_eda_budget_returns_none_not_wrong():
+    # A positions^2 product past the budget must answer "unknown", never
+    # a wrong verdict. 200 optional [^>] positions ≈ 40k product pairs
+    # with dense successor fans.
+    big = "(?i)<style[^>]*>[^<]{0,200}expression"
+    assert pattern_has_eda(big) in (None, False)
+
+
+# ---------------------------------------------------------------------------
+# CKO-R004: shadowed rules
+# ---------------------------------------------------------------------------
+
+
+def test_shadowed_rule_flagged():
+    doc = (
+        'SecRule ARGS|REQUEST_URI "@rx hel" "id:300,phase:2,t:none,deny,status:403"\n'
+        'SecRule ARGS "@rx hello" "id:301,phase:2,t:none,deny,status:403"\n'
+    )
+    hits = _find(doc, "CKO-R004")
+    assert [f.rule_id for f in hits] == [301]
+    assert "300" in hits[0].message
+
+
+def test_non_superset_targets_not_shadowed():
+    # Later rule watches REQUEST_URI too; earlier only ARGS.
+    doc = (
+        'SecRule ARGS "@rx hel" "id:300,phase:2,t:none,deny,status:403"\n'
+        'SecRule ARGS|REQUEST_URI "@rx hello" "id:301,phase:2,t:none,deny,status:403"\n'
+    )
+    assert _find(doc, "CKO-R004") == []
+
+
+def test_non_terminal_earlier_rule_does_not_shadow():
+    doc = (
+        'SecRule ARGS|REQUEST_URI "@rx hel" "id:300,phase:2,t:none,pass"\n'
+        'SecRule ARGS "@rx hello" "id:301,phase:2,t:none,deny,status:403"\n'
+    )
+    assert _find(doc, "CKO-R004") == []
+
+
+def test_different_phase_does_not_shadow():
+    doc = (
+        'SecRule REQUEST_URI "@rx hel" "id:300,phase:1,t:none,deny,status:403"\n'
+        'SecRule REQUEST_URI "@rx hello" "id:301,phase:2,t:none,deny,status:403"\n'
+    )
+    assert _find(doc, "CKO-R004") == []
+
+
+def test_detection_only_mode_never_shadows():
+    doc = (
+        "SecRuleEngine DetectionOnly\n"
+        'SecRule ARGS|REQUEST_URI "@rx hel" "id:300,phase:2,t:none,deny,status:403"\n'
+        'SecRule ARGS "@rx hello" "id:301,phase:2,t:none,deny,status:403"\n'
+    )
+    assert [f.code for f in analyze_ruleset(doc).findings if f.code == "CKO-R004"] == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-R005: dead links / chains that can never fire
+# ---------------------------------------------------------------------------
+
+
+def test_nomatch_chain_tail_flagged():
+    doc = (
+        'SecRule ARGS "@rx foo" "id:500,phase:2,deny,status:403,chain"\n'
+        'SecRule ARGS "@nomatch" "t:none"\n'
+    )
+    hits = _find(doc, "CKO-R005")
+    assert [f.rule_id for f in hits] == [500]
+
+
+def test_negated_unconditional_flagged():
+    doc = 'SecRule ARGS "!@unconditionalMatch" "id:501,phase:2,deny,status:403"\n'
+    assert [f.rule_id for f in _find(doc, "CKO-R005")] == [501]
+
+
+def test_live_chain_not_flagged():
+    doc = (
+        'SecRule ARGS "@rx foo" "id:502,phase:2,deny,status:403,chain"\n'
+        'SecRule ARGS "@rx bar" "t:none"\n'
+    )
+    assert _find(doc, "CKO-R005") == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-R006: variables no extractor populates
+# ---------------------------------------------------------------------------
+
+
+def test_unpopulated_variable_flagged():
+    doc = 'SecRule GEO:COUNTRY_CODE "@rx XX" "id:400,phase:2,deny,status:403"\n'
+    assert [f.rule_id for f in _find(doc, "CKO-R006")] == [400]
+
+
+def test_extracted_variable_not_flagged():
+    doc = 'SecRule ARGS "@rx XX" "id:401,phase:2,deny,status:403"\n'
+    assert _find(doc, "CKO-R006") == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-R007 + CKO-R010: skip ledger and the TPU-coverage report
+# ---------------------------------------------------------------------------
+
+
+def test_skipped_rule_and_coverage():
+    doc = (
+        'SecRule TX:blocked "@rx hello" "id:600,phase:2,t:none,deny,status:403"\n'
+        'SecRule ARGS "@rx world" "id:601,phase:2,t:none,deny,status:403"\n'
+    )
+    report = analyze_ruleset(BASE + doc)
+    assert [f.rule_id for f in report.findings if f.code == "CKO-R007"] == [600]
+    cov = report.coverage
+    assert cov["device_rules"] == 1
+    assert cov["skipped_rules"] == 1
+    assert cov["coverage_pct"] == 50.0
+    assert any(f.code == "CKO-R010" for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# CKO-R008 / CKO-R009: parse + compile failures become findings
+# ---------------------------------------------------------------------------
+
+
+def test_parse_error_is_finding():
+    report = analyze_ruleset("SecRule ARGS\n")
+    assert [f.code for f in report.errors] == ["CKO-R008"]
+
+
+def test_compile_error_is_finding():
+    report = analyze_ruleset(
+        BASE + 'SecRule ARGS "@rx x(?!y)" "id:700,phase:2,deny,status:403"\n'
+    )
+    assert [f.code for f in report.errors] == ["CKO-R009"]
+
+
+# ---------------------------------------------------------------------------
+# Determinism: CompileReport + AnalysisReport
+# ---------------------------------------------------------------------------
+
+_DETERMINISM_DOC = BASE + (
+    'SecRule TX:a "@rx foo" "id:800,phase:2,t:none,deny,status:403"\n'
+    'SecRule TX:b "@rx bar" "id:801,phase:2,t:none,deny,status:403"\n'
+    'SecRule ARGS "@rx (a+)+$" "id:802,phase:2,t:none,deny,status:403"\n'
+)
+
+
+def test_compile_report_sorted_and_deduped():
+    crs = compile_rules(_DETERMINISM_DOC)
+    assert crs.report.skipped == sorted(set(crs.report.skipped))
+    # The metrics-facing alias sees the same ledger.
+    assert crs.report.approximated == crs.report.approximations
+
+
+def test_compile_report_dedupes_repeated_entries():
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import CompileReport
+
+    rep = CompileReport()
+    rep.skip(5, "same reason")
+    rep.skip(5, "same reason")
+    rep.skip(3, "other")
+    rep.approximate(7, "approx")
+    rep.approximate(7, "approx")
+    rep.finalize()
+    assert rep.skipped == [(3, "other"), (5, "same reason")]
+    assert rep.approximated == [(7, "approx")]
+
+
+def test_analysis_is_byte_identical_across_runs():
+    a = analyze_ruleset(_DETERMINISM_DOC).dumps()
+    b = analyze_ruleset(_DETERMINISM_DOC).dumps()
+    assert a == b
+
+
+def test_finding_key_excludes_detail():
+    from coraza_kubernetes_operator_tpu.analysis.findings import Finding
+
+    f1 = Finding(code="X", severity="error", message="m", detail="one")
+    f2 = Finding(code="X", severity="error", message="m", detail="two")
+    assert f1.key == f2.key
+    rep = AnalysisReport()
+    rep.add(f1)
+    rep.add(f2)
+    assert len(rep.finalize().findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# CRS-lite corpus: zero errors, snapshot of warn counts
+# ---------------------------------------------------------------------------
+
+
+def test_crs_lite_analyzes_clean():
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules_cached
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+    from coraza_kubernetes_operator_tpu.seclang.parser import parse
+
+    cache_dir = str(Path(__file__).resolve().parent / ".crs_cache")
+    text = load_ruleset_text()
+    crs = compile_rules_cached(text, cache_dir=cache_dir)
+    report = AnalysisReport()
+    for f in duplicate_id_findings(text):
+        report.add(f)
+    analyze_compiled(parse(text), crs, report)
+
+    assert report.errors == [], "\n".join(f.render() for f in report.errors)
+    # Snapshot: CRS-lite is warning-free today; a new warning (a newly
+    # shadowed rule, a rule falling off the device plan) must be a
+    # conscious corpus/compiler decision, not drift.
+    by_code = collections.Counter(f.code for f in report.findings)
+    assert by_code == {"CKO-R003": 4, "CKO-R010": 1}, dict(by_code)
+    assert report.coverage["coverage_pct"] == 100.0
+    assert report.coverage["skipped_rules"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Wiring: controller Analyzed condition
+# ---------------------------------------------------------------------------
+
+
+def test_controller_sets_analyzed_condition():
+    from coraza_kubernetes_operator_tpu.cache import RuleSetCache
+    from coraza_kubernetes_operator_tpu.controlplane import (
+        ConfigMap,
+        FakeRecorder,
+        ObjectMeta,
+        ObjectStore,
+        RuleSet,
+        RuleSetSpec,
+        RuleSourceReference,
+    )
+    from coraza_kubernetes_operator_tpu.controlplane.conditions import get_condition
+    from coraza_kubernetes_operator_tpu.controlplane.ruleset_controller import (
+        RuleSetReconciler,
+    )
+
+    ns = "lint-ns"
+    store = ObjectStore()
+    recorder = FakeRecorder()
+
+    def reconcile(rules: str):
+        store.create(
+            ConfigMap(metadata=ObjectMeta(name="cm", namespace=ns), data={"rules": rules})
+        )
+        store.create(
+            RuleSet(
+                metadata=ObjectMeta(name="rs", namespace=ns),
+                spec=RuleSetSpec(rules=[RuleSourceReference("cm")]),
+            )
+        )
+        RuleSetReconciler(store, RuleSetCache(), recorder).reconcile(ns, "rs")
+        return store.get("RuleSet", ns, "rs").status.conditions
+
+    clean = 'SecRule ARGS "@rx hello" "id:1,phase:2,t:none,deny,status:403"'
+    cond = get_condition(reconcile(BASE + clean), "Analyzed")
+    assert cond is not None and cond.status == "True"
+    assert cond.reason == "RulesAnalyzed"
+    assert "0 error(s)" in cond.message
+
+    # Error findings flip Analyzed to False but do NOT block Ready.
+    store.get("ConfigMap", ns, "cm").data["rules"] = BASE + (
+        'SecRule TX:blocked "@rx (a+)+$" "id:2,phase:2,t:none,deny,status:403"'
+    )
+    RuleSetReconciler(store, RuleSetCache(), recorder).reconcile(ns, "rs")
+    conds = store.get("RuleSet", ns, "rs").status.conditions
+    analyzed = get_condition(conds, "Analyzed")
+    assert analyzed is not None and analyzed.status == "False"
+    assert analyzed.reason == "ErrorFindings"
+    assert get_condition(conds, "Ready").status == "True"
+    assert recorder.has_event("Warning", "AnalysisFindings")
+
+
+# ---------------------------------------------------------------------------
+# Wiring: sidecar hot-reload analysis gate + stats/metrics exposure
+# ---------------------------------------------------------------------------
+
+GOOD_RULES = BASE + (
+    'SecRule ARGS|REQUEST_URI "@contains evilmonkey" '
+    '"id:3001,phase:2,deny,status:403,t:none"\n'
+)
+# Compiles fine, but the TX string-match skip puts its EDA pattern on the
+# host path: one new error-severity finding (CKO-R002).
+BAD_RULES = GOOD_RULES + (
+    'SecRule TX:blocked "@rx (a+)+$" "id:3002,phase:2,t:none,deny,status:403"\n'
+)
+
+
+@pytest.fixture()
+def cache_server():
+    from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+
+    srv = RuleSetCacheServer(RuleSetCache(), host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+KEY = "default/lint-rules"
+
+
+def _reloader(cache_server):
+    from coraza_kubernetes_operator_tpu.sidecar.reloader import RuleReloader
+
+    return RuleReloader(
+        cache_base_url=f"http://127.0.0.1:{cache_server.port}",
+        instance_key=KEY,
+        poll_interval_s=3600,
+    )
+
+
+def test_reload_gate_refuses_new_error_finding(cache_server, monkeypatch):
+    monkeypatch.delenv("CKO_ANALYZE_OVERRIDE", raising=False)
+    r = _reloader(cache_server)
+    cache_server.cache.put(KEY, GOOD_RULES)
+    assert r.poll_once() is True
+    good_engine = r.engine
+    assert r.analysis is not None and r.analysis.errors == []
+
+    cache_server.cache.put(KEY, BAD_RULES)
+    assert r.poll_once() is False  # refused
+    assert r.engine is good_engine  # previous ruleset keeps serving
+    assert r.analyze_rejected == 1
+    assert r.failed_reloads == 1
+
+    # The refused version is latched: the next poll does not re-fetch,
+    # re-compile, and re-refuse the same document every interval.
+    assert r.poll_once() is False
+    assert r.analyze_rejected == 1
+
+    # The SAME document under override swaps in.
+    monkeypatch.setenv("CKO_ANALYZE_OVERRIDE", "1")
+    cache_server.cache.put(KEY, BAD_RULES)  # fresh uuid
+    assert r.poll_once() is True
+    assert r.engine is not good_engine
+    assert len(r.analysis.errors) == 1
+
+
+def test_reload_gate_allows_preexisting_errors(cache_server, monkeypatch):
+    """The gate is *new errors only*: a document that already had an error
+    finding can be reloaded with an unrelated change (otherwise a flagged
+    fleet could never ship a fix)."""
+    monkeypatch.delenv("CKO_ANALYZE_OVERRIDE", raising=False)
+    r = _reloader(cache_server)
+    cache_server.cache.put(KEY, BAD_RULES)
+    assert r.poll_once() is True  # first load is never gated
+    assert len(r.analysis.errors) == 1
+
+    cache_server.cache.put(
+        KEY,
+        BAD_RULES
+        + 'SecRule ARGS "@contains tiger" "id:3003,phase:2,deny,status:403,t:none"\n',
+    )
+    assert r.poll_once() is True  # same error key as before: admitted
+    assert r.analyze_rejected == 0
+
+
+def test_first_load_with_errors_is_admitted(cache_server, monkeypatch):
+    monkeypatch.delenv("CKO_ANALYZE_OVERRIDE", raising=False)
+    r = _reloader(cache_server)
+    cache_server.cache.put(KEY, BAD_RULES)
+    assert r.poll_once() is True
+    assert r.engine is not None
+
+
+def test_stats_expose_analysis_and_skip_metrics(cache_server, monkeypatch):
+    monkeypatch.delenv("CKO_ANALYZE_OVERRIDE", raising=False)
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    cache_server.cache.put(KEY, BAD_RULES)
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            cache_base_url=f"http://127.0.0.1:{cache_server.port}",
+            instance_key=KEY,
+            poll_interval_s=0.05,
+            host="127.0.0.1",
+            port=0,
+        )
+    )
+    sc.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not sc.ready():
+            time.sleep(0.05)
+        assert sc.ready()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{sc.port}/waf/v1/stats", timeout=10
+        ) as resp:
+            stats = json.loads(resp.read())
+        findings = stats["analysis"]["cko_analysis_findings_total"]
+        assert findings["error"] == 1  # BAD_RULES' host-path EDA pattern
+        assert stats["cko_rules_skipped_total"] == 1  # the TX rule
+        assert stats["cko_rules_approximated_total"] == 0
+        tenant = stats["tenants"][KEY]
+        assert tenant["analysis"]["error"] == 1
+
+        # Prometheus surface renders the same numbers.
+        rendered = sc.metrics.render()
+        assert 'cko_analysis_findings_total{severity="error"} 1' in rendered
+        assert "cko_rules_skipped_total 1" in rendered
+    finally:
+        sc.stop()

@@ -30,7 +30,7 @@ def _traffic(seed, n=48):
     rng = random.Random(seed)
     uas = ["curl/8.0", "Mozilla/5.0", "sqlmap/1.7", "Go-http-client/1.1"]
     reqs = []
-    for i in range(n):
+    for _i in range(n):
         roll = rng.random()
         if roll < 0.2:
             uri = f"/search?q=1+UNION+SELECT+x{rng.randrange(100)}"
