@@ -55,6 +55,10 @@ bench:  ## Streaming JSON benchmark: one line per config + final summary.
 pipeline.smoke:  ## Host/device overlap gate: pipelined >= 1.2x sync, verdicts identical.
 	$(PYTHON) hack/pipeline_smoke.py
 
+.PHONY: chaos.smoke
+chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage.
+	$(PYTHON) hack/chaos_smoke.py
+
 # bench.warm populates .jax_bench_cache with the FINAL compiler's HLO so
 # the driver's timed run hits a warm XLA cache (VERDICT r3 item 1d). Runs
 # every config once with minimal iters; throughput output is discarded.

@@ -957,6 +957,33 @@ def _summary(configs: dict) -> dict:
     return result
 
 
+def _timeout_record(budget_s: float, elapsed_s: float) -> dict:
+    """The per-config record written when a child blows its wall budget
+    (subprocess timeout) or is killed by an external ``timeout`` wrapper
+    (rc=124). Carries an explicit ``"timeout": true`` plus the elapsed
+    wall so BENCH_OUT keeps a graded partial instead of going blind
+    exactly when the perf trajectory regresses (ROADMAP item 2)."""
+    return {
+        "error": "budget",
+        "timeout": True,
+        "budget_s": round(budget_s, 1),
+        "elapsed_s": round(elapsed_s, 1),
+    }
+
+
+def _merge_partial(record: dict, partial: dict | None) -> dict:
+    """Fold the child's best streamed partial line into an error record,
+    keeping the error/timeout/elapsed diagnosis alongside the salvaged
+    numbers (the old merge dropped the timeout marker)."""
+    if partial is None:
+        return record
+    merged = {**partial, "late_error": record.get("error", "unknown")}
+    for key in ("timeout", "budget_s", "elapsed_s"):
+        if key in record:
+            merged[key] = record[key]
+    return merged
+
+
 def _write_partial(configs: dict) -> None:
     """Persist the summary-so-far after EVERY config (ISSUE 1 satellite:
     an rc-124 kill of the whole harness must still leave every finished
@@ -1074,18 +1101,28 @@ def main() -> None:
                             "error": f"no output (rc {proc.returncode})",
                             "stderr_tail": proc.stderr[-400:],
                         }
+                    if proc.returncode == 124:
+                        # The child was killed by an external `timeout`
+                        # wrapper: record it as a timeout (with elapsed
+                        # wall) even when it streamed partial lines.
+                        configs[key].setdefault("timeout", True)
+                        configs[key].setdefault(
+                            "elapsed_s", round(time.monotonic() - t0, 1)
+                        )
+                        break
                 except subprocess.TimeoutExpired as err:
                     # Salvage whatever the child streamed before the kill:
                     # config 3 emits its fallback-mode partial FIRST, so a
-                    # budget breach still lands a graded number instead of
-                    # a bare {"error": "budget"}.
+                    # budget breach still lands a graded number — now with
+                    # an explicit "timeout": true + elapsed wall in
+                    # BENCH_OUT instead of a bare {"error": "budget"}.
                     lines = parse_lines(
                         err.stdout
                         if isinstance(err.stdout, str)
                         else (err.stdout or b"").decode("utf-8", "replace")
                     )
                     partial = best_partial(lines) or partial
-                    configs[key] = {"error": "budget", "budget_s": round(budget, 1)}
+                    configs[key] = _timeout_record(budget, time.monotonic() - t0)
                     break
                 except Exception as err:
                     configs[key] = {"error": f"{type(err).__name__}: {err}"}
@@ -1093,7 +1130,7 @@ def main() -> None:
                     break
                 time.sleep(3)
             if "error" in configs[key] and partial is not None:
-                configs[key] = {**partial, "late_error": configs[key]["error"]}
+                configs[key] = _merge_partial(configs[key], partial)
             configs[key].setdefault("wall_s", round(time.monotonic() - t0, 1))
             _emit({"config": key, **configs[key]})
             _write_partial(configs)

@@ -121,6 +121,35 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         " $CKO_COMPILE_CACHE_DIR): cold sidecar starts warm-start their"
         " executable compiles from disk; '0' disables",
     )
+    p.add_argument(
+        "--disable-rollout",
+        action="store_true",
+        help="revert hot reloads to the legacy compile-gate-swap path"
+        " instead of the staged rollout pipeline (docs/ROLLOUT.md:"
+        " budgeted background compile, shadow verification, rollback)",
+    )
+    p.add_argument(
+        "--compile-budget-seconds",
+        type=float,
+        default=None,
+        help="wall budget for a rollout candidate's compile + prewarm"
+        " (default $CKO_COMPILE_BUDGET_S or 600); a blown budget records"
+        " a failed rollout and leaves serving untouched",
+    )
+    p.add_argument(
+        "--shadow-promote-windows",
+        type=int,
+        default=None,
+        help="shadow-verified windows required to promote a candidate"
+        " (default $CKO_SHADOW_PROMOTE_WINDOWS or 3; 0 swaps directly)",
+    )
+    p.add_argument(
+        "--shadow-sample-rate",
+        type=float,
+        default=None,
+        help="fraction of live windows mirrored through a staged"
+        " candidate (default $CKO_SHADOW_SAMPLE_RATE or 1.0)",
+    )
     args = p.parse_args(argv)
 
     # Wire the persistent compile cache BEFORE any engine compiles: a
@@ -153,6 +182,10 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         queue_budget=args.queue_budget,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_seconds,
+        rollout_enabled=not args.disable_rollout,
+        compile_budget_s=args.compile_budget_seconds,
+        shadow_promote_windows=args.shadow_promote_windows,
+        shadow_sample_rate=args.shadow_sample_rate,
     )
 
 

@@ -67,6 +67,38 @@ def set_status_analyzed(
     _set(conditions, _cond("Analyzed", ok, generation, reason, message))
 
 
+# Data-plane rollout states worth surfacing on the RuleSet (the sidecar's
+# staged-rollout machine, sidecar/rollout.py / docs/ROLLOUT.md).
+_ROLLOUT_REASONS = {
+    "staged": "RolloutStaged",
+    "shadowing": "RolloutShadowing",
+    "promoted": "RolloutPromoted",
+    "rolled_back": "RolloutRolledBack",
+    "failed": "RolloutFailed",
+}
+
+
+def set_status_rollout(
+    conditions: list[Condition], generation: int, state: str, message: str
+) -> None:
+    """``RolloutState`` mirrors the data plane's staged-rollout state
+    machine onto the RuleSet. Like ``Analyzed``, it rides alongside the
+    Ready tri-state: a cached RuleSet stays Ready even while a sidecar
+    is still shadow-verifying it (or has rolled it back) — the condition
+    tells the operator which version of the truth the data plane is
+    actually serving. True only once the version was promoted."""
+    _set(
+        conditions,
+        _cond(
+            "RolloutState",
+            state == "promoted",
+            generation,
+            _ROLLOUT_REASONS.get(state, "RolloutUnknown"),
+            message,
+        ),
+    )
+
+
 def get_condition(conditions: list[Condition], cond_type: str) -> Condition | None:
     for c in conditions:
         if c.type == cond_type:

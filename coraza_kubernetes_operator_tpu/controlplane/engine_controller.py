@@ -220,6 +220,26 @@ class EngineReconciler:
                                 "image": tpu.image,
                                 "args": args,
                                 "ports": [{"containerPort": 9090, "name": "http"}],
+                                # Liveness = the process answers; readiness
+                                # = a ruleset is loaded and the serving mode
+                                # is not broken (sidecar/server.py). Split
+                                # so Kubernetes stops ROUTING to a dead
+                                # sidecar without RESTARTING one that is
+                                # mid-compile.
+                                "livenessProbe": {
+                                    "httpGet": {
+                                        "path": "/waf/v1/healthz",
+                                        "port": "http",
+                                    },
+                                    "periodSeconds": 10,
+                                },
+                                "readinessProbe": {
+                                    "httpGet": {
+                                        "path": "/waf/v1/readyz",
+                                        "port": "http",
+                                    },
+                                    "periodSeconds": 5,
+                                },
                                 "resources": {
                                     "limits": {"google.com/tpu": "1"},
                                 },

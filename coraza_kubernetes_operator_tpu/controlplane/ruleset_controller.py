@@ -28,6 +28,7 @@ from .conditions import (
     set_status_degraded,
     set_status_progressing,
     set_status_ready,
+    set_status_rollout,
 )
 from .events import EventRecorder
 from .store import ObjectStore
@@ -128,6 +129,34 @@ class RuleSetReconciler:
         set_status_ready(ruleset.status.conditions, generation, "RulesCached", msg)
         self.store.update_status(ruleset)
         return ReconcileResult()
+
+    def observe_rollout(self, cache_key: str, state: str, message: str = "") -> None:
+        """Mirror the data plane's staged-rollout state machine
+        (``sidecar/rollout.py``) onto the RuleSet's ``RolloutState``
+        condition. ``cache_key`` is the sidecar's instance key —
+        ``namespace/name``, the same key the reconciler caches under.
+        Wired as the sidecar RolloutManager's ``on_state`` callback;
+        unknown keys are ignored (a sidecar may serve static rules no
+        RuleSet owns). A rollback or failure additionally records a
+        Warning event so ``kubectl describe`` tells the 3am story."""
+        namespace, _, name = cache_key.strip("/").partition("/")
+        ruleset: RuleSet | None = self.store.try_get("RuleSet", namespace, name)
+        if ruleset is None or ruleset.metadata.deleted:
+            return
+        generation = ruleset.metadata.generation
+        set_status_rollout(ruleset.status.conditions, generation, state, message)
+        if state in ("rolled_back", "failed"):
+            self.recorder.event(
+                ruleset,
+                "Warning",
+                "RolloutRolledBack" if state == "rolled_back" else "RolloutFailed",
+                message or f"data-plane rollout {state}",
+            )
+        elif state == "promoted":
+            self.recorder.event(
+                ruleset, "Normal", "RolloutPromoted", message or "candidate promoted"
+            )
+        self.store.update_status(ruleset)
 
     def _analyze(self, ruleset: RuleSet, generation: int, text: str, compiled) -> None:
         """Run rulelint over the aggregated document and record the result

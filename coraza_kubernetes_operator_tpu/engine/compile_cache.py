@@ -123,6 +123,14 @@ class ExecutableCache:
         # call rejected its arguments — should be zero in practice).
         self.bypasses = 0
         self._bypassed_keys: set[tuple] = set()
+        # Compiles currently running. A staged-rollout candidate whose
+        # budget blew is *abandoned* (Python cannot interrupt an XLA
+        # compile) but its compile thread keeps running to completion —
+        # deliberately, so the result still lands here and in the
+        # persistent disk cache, making the next attempt at the same
+        # ruleset cheap. This gauge is how an abandoned compile stays
+        # visible instead of becoming a silent background CPU burn.
+        self.inflight = 0
 
     # -- core ---------------------------------------------------------------
 
@@ -137,11 +145,17 @@ class ExecutableCache:
             return entry
 
     def _compile(self, key: tuple, jitted, args: tuple, kwargs: dict):
-        t0 = time.perf_counter()
-        lowered = jitted.lower(*args, **kwargs)
-        t1 = time.perf_counter()
-        compiled = lowered.compile()
-        t2 = time.perf_counter()
+        with self._lock:
+            self.inflight += 1
+        try:
+            t0 = time.perf_counter()
+            lowered = jitted.lower(*args, **kwargs)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+        finally:
+            with self._lock:
+                self.inflight -= 1
         with self._lock:
             self.misses += 1
             self.trace_s += t1 - t0
@@ -220,6 +234,7 @@ class ExecutableCache:
                 "compile_s": round(self.compile_s, 3),
                 "trace_s": round(self.trace_s, 3),
                 "bypasses": self.bypasses,
+                "inflight": self.inflight,
                 "persistent_dir": _configured_dir[0] if _configured_dir else None,
             }
 

@@ -278,6 +278,21 @@ def tier_tensors(tensors, kind_lut=None, cache=None):
     return tuple(tiers), numvals, tuple(masks), tuple(cached), miss_keys
 
 
+def warmup_request() -> HttpRequest:
+    """THE canonical warmup/canary request. The degraded-mode promotion
+    probe, ``WafEngine.prewarm``'s default batch, and the rollout
+    subsystem's candidate canary + idle self-check all build it here so
+    they share ONE shape signature: the executable a probe pre-warms is
+    exactly the executable the next canary dispatch (and a staged
+    candidate's first shadow check) hits in the cache."""
+    return HttpRequest(
+        method="GET",
+        uri="/__cko_warmup__",
+        headers=[("host", "cko-warmup.local"), ("user-agent", "cko-promote/1")],
+        body=b"",
+    )
+
+
 @dataclass
 class Verdict:
     """Per-request evaluation outcome (the sidecar turns this into 403/200,
@@ -831,14 +846,7 @@ class WafEngine:
         from .compile_cache import EXEC_CACHE
 
         if requests is None:
-            requests = [
-                HttpRequest(
-                    method="GET",
-                    uri="/__cko_warmup__",
-                    headers=[("host", "cko-warmup.local")],
-                    body=b"",
-                )
-            ]
+            requests = [warmup_request()]
         t0 = time.perf_counter()
         compiled = False
         batches = [requests]

@@ -11,12 +11,15 @@ contract the WASM plugin uses (uuid change ⇒ recompile ⇒ swap tables).
 from .batcher import MicroBatcher
 from .degraded import CircuitBreaker, DegradedModeManager
 from .reloader import RuleReloader
+from .rollout import RolloutConfig, RolloutManager
 from .server import SidecarConfig, TpuEngineSidecar
 
 __all__ = [
     "CircuitBreaker",
     "DegradedModeManager",
     "MicroBatcher",
+    "RolloutConfig",
+    "RolloutManager",
     "RuleReloader",
     "SidecarConfig",
     "TpuEngineSidecar",

@@ -193,6 +193,14 @@ class MicroBatcher:
         # NOT device failures and bypass these.
         self.on_engine_error = None  # (engine, err) -> None
         self.on_engine_success = None  # (engine,) -> None
+        # Shadow mirror (sidecar/rollout.py): every successfully collected
+        # window group is offered as (engine, requests, verdicts,
+        # serving_s) so a staged rollout candidate can replay the SAME
+        # live traffic and compare verdicts. The hook must be cheap and
+        # non-blocking (the rollout manager samples and drops on a full
+        # queue); like the breaker hooks it is a side channel — a raising
+        # hook never decides a verdict.
+        self.on_window = None  # (engine, requests, verdicts, serving_s) -> None
 
     @property
     def busy(self) -> bool:
@@ -445,6 +453,22 @@ class MicroBatcher:
             self._notify(self.on_engine_success, g.engine)
             for i, verdict in zip(g.idxs, g.verdicts):
                 _resolve(record.window[i][2].set_result, verdict)
+            if self.on_window is not None:
+                inflight = g.inflight
+                serving_s = (
+                    getattr(inflight, "host_s", 0.0)
+                    + getattr(inflight, "device_s", 0.0)
+                    + getattr(inflight, "decode_s", 0.0)
+                    if inflight is not None
+                    else time.monotonic() - g.t_dispatch
+                )
+                self._notify(
+                    self.on_window,
+                    g.engine,
+                    [record.window[i][0] for i in g.idxs],
+                    list(g.verdicts),
+                    serving_s,
+                )
             # One stats sample per model group: each group is its own
             # device step, so waf_batch_step_seconds / waf_batch_size keep
             # measuring a single device batch even in multi-tenant

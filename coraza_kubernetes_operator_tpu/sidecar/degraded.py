@@ -27,6 +27,17 @@ of failure but its data plane has no second evaluator to fall back on
 (SURVEY §5); the host scalar-DFA path (cf. Hyperflex, arXiv:2512.07123;
 approximate-NFA DPI, arXiv:1904.10786) is fast enough to be that
 stopgap.
+
+Composition with staged rollouts (``sidecar/rollout.py``, docs/ROLLOUT.md):
+a rollout candidate is prewarmed and canary-proven inside its compile
+budget, so a PROMOTED candidate arrives already ``warmed`` — ``mode_for``
+reports ``promoted`` immediately and the swap costs no fallback window.
+Candidate faults during shadow verification never reach this module's
+breaker (shadowing runs off the batcher, and only the batcher's outcome
+hooks feed ``record_device_failure``); conversely, while the baseline is
+cold/fallback/broken there is no proven device path to mirror against,
+so the rollout skips shadowing and swaps directly — this state machine
+then warms the new engine exactly as it always has.
 """
 
 from __future__ import annotations
@@ -35,6 +46,7 @@ import threading
 import time
 
 from ..engine.request import HttpRequest
+from ..engine.waf import warmup_request
 from ..utils import get_logger
 
 log = get_logger("sidecar.degraded")
@@ -127,12 +139,10 @@ class CircuitBreaker:
 
 
 def _canary_request() -> HttpRequest:
-    return HttpRequest(
-        method="GET",
-        uri="/__cko_warmup__",
-        headers=[("host", "cko-warmup.local"), ("user-agent", "cko-promote/1")],
-        body=b"",
-    )
+    # One canonical canary (engine/waf.warmup_request): the probe, the
+    # prewarm default, and the rollout canary/idle self-check share one
+    # shape signature, so each proves the executable the others reuse.
+    return warmup_request()
 
 
 class DegradedModeManager:

@@ -9,9 +9,19 @@ configures into coraza-proxy-wasm (pluginConfig keys
 
 - every ``poll_interval_s``: ``GET /rules/{key}/latest`` → ``{uuid, ts}``;
 - uuid unchanged → nothing;
-- uuid changed → ``GET /rules/{key}`` → full rules → compile (slow, Python,
-  happens on this thread — never on the serving path) → build device model
-  → atomic engine swap; the next batch window picks it up.
+- uuid changed → ``GET /rules/{key}`` → full rules → **staged rollout**
+  (docs/ROLLOUT.md): a budgeted background worker compiles the candidate
+  (``CKO_COMPILE_BUDGET_S``), the analysis gate runs, the candidate is
+  prewarmed, live traffic is shadow-mirrored through it, and only after
+  N clean windows does it swap in — with the previous engine pushed onto
+  a last-known-good ring for ``POST /waf/v1/rollback``. A blown budget,
+  gate refusal, verdict divergence, candidate fault, or latency
+  regression leaves the serving engine untouched and records a
+  failed/rolled-back rollout. The poll thread NEVER compiles once a
+  ruleset is serving.
+- first load (nothing serving yet), or no rollout manager wired (tests,
+  standalone use): the legacy inline path — compile on this thread,
+  gate, atomic swap.
 
 Compile failures keep the previous engine serving (the WASM plugin behaves
 the same way: last-loaded rules keep running).
@@ -24,23 +34,36 @@ included file — is refused and the previous engine keeps serving, unless
 ``CKO_ANALYZE_OVERRIDE=1`` is set. The first load is never gated (there
 is no previous ruleset to keep serving; admission-time analysis is the
 controller's job) and an analyzer *crash* never blocks a reload.
+
+Poll jitter: every wait is multiplied by a ±20% uniform factor so a fleet
+of tenant sidecars that all saw a cache-server outage clear does not
+re-synchronize into a thundering herd of polls (and recompiles) on the
+same beat.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 
 from ..analysis.findings import AnalysisReport
 from ..engine.waf import WafEngine
 from ..utils import get_logger
+from .rollout import EngineRing, RolloutManager, RolloutRefused
 
 log = get_logger("sidecar.reloader")
 
 ANALYZE_OVERRIDE_ENV = "CKO_ANALYZE_OVERRIDE"
+# A failed/rolled-back rollout latches its uuid so the poller does not
+# re-compile the same bad document every interval. 0 (default) keeps the
+# latch until a NEW version is published; >0 retries after that many
+# seconds (for transient causes — a device fault storm that cleared).
+ROLLOUT_RETRY_ENV = "CKO_ROLLOUT_RETRY_S"
 
 DEFAULT_POLL_INTERVAL_S = 15.0
 # Failure backoff: after a failed poll the next attempt comes quickly and
@@ -48,6 +71,8 @@ DEFAULT_POLL_INTERVAL_S = 15.0
 # outage must not delay the FIRST ruleset load by a whole poll period
 # (fail-closed sidecars answer 503 until it lands).
 BACKOFF_BASE_S = 0.5
+# ±20% poll jitter (thundering-herd decorrelation across a tenant fleet).
+JITTER_FRACTION = 0.2
 
 
 class RuleReloader:
@@ -60,6 +85,7 @@ class RuleReloader:
         poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
         engine_factory=WafEngine,
         on_swap=None,
+        rollout: RolloutManager | None = None,
     ):
         # on_swap(engine): called after every atomic engine swap — the
         # sidecar uses it to kick background device promotion for the
@@ -77,6 +103,7 @@ class RuleReloader:
         self._loaded_once = threading.Event()
         self.reloads = 0
         self.failed_reloads = 0
+        self.polls = 0
         # Cache-poll health (degraded-mode observability): total failed
         # fetches and the current consecutive-failure streak driving the
         # retry backoff.
@@ -90,6 +117,21 @@ class RuleReloader:
         self.analysis: AnalysisReport | None = None
         self.analyze_rejected = 0
         self._rejected_uuid: str | None = None
+        # Staged rollout (docs/ROLLOUT.md): manager (None = legacy inline
+        # reloads), last-known-good engine ring for forced rollback, and
+        # the failed-rollout uuid latch.
+        self._rollout_mgr = rollout
+        ring_depth = rollout.config.ring_depth if rollout is not None else 2
+        self.ring = EngineRing(ring_depth)
+        self.rollbacks_forced = 0
+        self._swap_lock = threading.Lock()
+        self._rollout_latched: dict[str, float] = {}
+        # Bumped by every forced rollback. A rollout captures the epoch
+        # when it stages; its promotion swap is honored only if no forced
+        # rollback intervened — closing the race where a candidate wins
+        # its terminal transition just before the operator's abort and
+        # would otherwise swap in anyway, silently overriding them.
+        self._swap_epoch = 0
 
     # -- public --------------------------------------------------------------
 
@@ -126,18 +168,26 @@ class RuleReloader:
     def next_wait_s(self) -> float:
         """Sleep until the next poll attempt: the normal interval when
         healthy, exponential backoff (BACKOFF_BASE_S · 2^k, capped at the
-        interval) while the cache server is failing."""
+        interval) while the cache server is failing — times a ±20%
+        jitter factor, so a fleet whose cache outage just cleared fans
+        its polls out instead of stampeding the server on one beat."""
         k = self.consecutive_poll_failures
         if k <= 0:
-            return self.poll_interval_s
-        return min(self.poll_interval_s, BACKOFF_BASE_S * (2 ** (k - 1)))
+            base = self.poll_interval_s
+        else:
+            base = min(self.poll_interval_s, BACKOFF_BASE_S * (2 ** (k - 1)))
+        return base * random.uniform(1.0 - JITTER_FRACTION, 1.0 + JITTER_FRACTION)
 
     def _poll_failed(self) -> None:
         self.poll_failures += 1
         self.consecutive_poll_failures += 1
 
     def poll_once(self) -> bool:
-        """One poll step; returns True if a new ruleset was swapped in."""
+        """One poll step; returns True if a new ruleset was swapped in.
+        With a rollout manager wired and a ruleset already serving, a new
+        version only *stages* here (False) — the swap happens when the
+        candidate's shadow verification promotes it."""
+        self.polls += 1
         try:
             latest = self._get_json(f"/rules/{self.instance_key}/latest")
         except (urllib.error.URLError, ValueError, OSError) as err:
@@ -156,6 +206,13 @@ class RuleReloader:
             return False
         if uuid == self._rejected_uuid and os.environ.get(ANALYZE_OVERRIDE_ENV) != "1":
             return False  # already refused by the analysis gate; don't re-compile
+        if self._is_rollout_latched(uuid):
+            return False  # rollout failed/rolled back; wait for a new version
+        mgr = self._rollout_mgr
+        if mgr is not None:
+            active = mgr.active(self.instance_key)
+            if active is not None and active.uuid == uuid:
+                return False  # this version is already staging/shadowing
         try:
             entry = self._get_json(f"/rules/{self.instance_key}")
         except (urllib.error.URLError, ValueError, OSError) as err:
@@ -163,6 +220,32 @@ class RuleReloader:
             log.info("rules fetch failed", key=self.instance_key, error=str(err))
             return False
         rules = entry.get("rules", "")
+        if mgr is not None and self._engine is not None:
+            # A newer version supersedes any in-flight candidate: the
+            # operator's latest intent wins; the old candidate is
+            # discarded without ever having served. Aborted only AFTER
+            # the replacement's rules fetched successfully — a transient
+            # fetch failure must not discard a healthy candidate for
+            # nothing.
+            if mgr.active(self.instance_key) is not None:
+                mgr.abort(self.instance_key, f"superseded by {uuid}")
+            # Staged rollout: compile + gate + prewarm + shadow-verify in
+            # a budgeted background worker. This poll thread returns NOW —
+            # a minutes-long candidate compile can never stall polling,
+            # and the serving engine is untouched until promotion.
+            epoch = self._swap_epoch
+            mgr.begin(
+                self.instance_key,
+                uuid,
+                self._engine,
+                build=lambda: self._build_candidate(rules, uuid),
+                on_promote=lambda r: self._rollout_promoted(r, epoch),
+                on_fail=self._rollout_failed,
+            )
+            return False
+        # Legacy inline path: first load (nothing serving — there is no
+        # traffic to protect and no baseline to shadow against) or no
+        # rollout manager wired.
         try:
             engine = self._engine_factory(rules)
         except Exception as err:  # invalid rules: keep serving previous engine
@@ -175,15 +258,85 @@ class RuleReloader:
             self.analyze_rejected += 1
             self._rejected_uuid = uuid
             return False
-        if report is not None:
-            self.analysis = report
-        # else: analyzer crashed — keep the previous baseline so the next
-        # reload still compares against real findings (an empty baseline
-        # would read every pre-existing error as "new" and refuse a fix).
-        self._rejected_uuid = None
-        self._engine = engine  # atomic swap; next batch window uses it
-        self._uuid = uuid
-        self.reloads += 1
+        self._swap(uuid, engine, report)
+        return True
+
+    # -- staged rollout (docs/ROLLOUT.md) ------------------------------------
+
+    def _build_candidate(self, rules: str, uuid: str):
+        """Rollout worker's build step: compile + analysis gate, off the
+        poll thread. Raises :class:`RolloutRefused` on a gate refusal
+        (latching the uuid exactly like the inline path) and lets
+        compile errors propagate — either way the rollout records a
+        failure and the serving engine is untouched."""
+        engine = self._engine_factory(rules)
+        report = self._analyze(rules, engine)
+        if not self._admit(report, uuid):
+            self.analyze_rejected += 1
+            self._rejected_uuid = uuid
+            raise RolloutRefused(
+                "analysis gate refused candidate (new error-severity findings)"
+            )
+        return engine, report
+
+    def _rollout_promoted(self, r, epoch: int) -> None:
+        self._swap(r.uuid, r.engine, r.analysis, epoch=epoch)
+
+    def _rollout_failed(self, r) -> None:
+        self.failed_reloads += 1
+        # An analysis-gate refusal is already latched as _rejected_uuid,
+        # which honors CKO_ANALYZE_OVERRIDE=1 — rollout-latching it too
+        # would make the documented override silently inert (the rollout
+        # latch has no override escape by design).
+        if r.uuid != self._rejected_uuid:
+            self._latch_rollout(r.uuid)
+
+    def _latch_rollout(self, uuid: str | None) -> None:
+        if uuid:
+            self._rollout_latched[uuid] = time.monotonic()
+
+    def _is_rollout_latched(self, uuid: str) -> bool:
+        t = self._rollout_latched.get(uuid)
+        if t is None:
+            return False
+        try:
+            retry_s = float(os.environ.get(ROLLOUT_RETRY_ENV, "0") or 0)
+        except ValueError:
+            retry_s = 0.0
+        if retry_s > 0 and time.monotonic() - t >= retry_s:
+            self._rollout_latched.pop(uuid, None)
+            return False
+        return True
+
+    def _swap(
+        self, uuid: str | None, engine: WafEngine, report, epoch: int | None = None
+    ) -> None:
+        """THE swap invariant: push the previous engine onto the
+        last-known-good ring, then atomically install the new pair. Used
+        by the inline path and rollout promotion alike; a promotion swap
+        carries its staging-time epoch and is DISCARDED if a forced
+        rollback bumped it since — the operator's decision wins."""
+        with self._swap_lock:
+            if epoch is not None and epoch != self._swap_epoch:
+                self._latch_rollout(uuid)
+                log.info(
+                    "promotion discarded: forced rollback intervened",
+                    key=self.instance_key,
+                    uuid=uuid,
+                )
+                return
+            if self._engine is not None:
+                self.ring.push(self._uuid, self._engine)
+            if report is not None:
+                self.analysis = report
+            # else: analyzer crashed — keep the previous baseline so the
+            # next reload still compares against real findings (an empty
+            # baseline would read every pre-existing error as "new" and
+            # refuse a fix).
+            self._rejected_uuid = None
+            self._engine = engine  # atomic swap; next batch window uses it
+            self._uuid = uuid
+            self.reloads += 1
         self._loaded_once.set()
         if self._on_swap is not None:
             try:
@@ -197,7 +350,49 @@ class RuleReloader:
             rules=engine.compiled.n_rules,
             groups=engine.compiled.n_groups,
         )
-        return True
+
+    def force_rollback(self) -> dict | None:
+        """Operator-forced rollback (``POST /waf/v1/rollback``): abort any
+        in-flight rollout, swap serving back to the ring's most recent
+        last-known-good engine, and latch the rolled-back-from uuid so
+        the next poll does not immediately re-stage it. Returns the swap
+        summary, or None when the ring is empty."""
+        if self._rollout_mgr is not None:
+            active = self._rollout_mgr.active(self.instance_key)
+            if active is not None:
+                self._latch_rollout(active.uuid)
+            self._rollout_mgr.abort(self.instance_key, "forced rollback")
+        with self._swap_lock:
+            entry = self.ring.pop()
+            if entry is None:
+                return None
+            bad_uuid = self._uuid
+            prev_uuid, prev_engine = entry
+            self._engine = prev_engine
+            self._uuid = prev_uuid
+            self.rollbacks_forced += 1
+            self._latch_rollout(bad_uuid)
+            # Cancel any promotion swap still in flight for a candidate
+            # staged before this rollback (abort may have lost the race
+            # to its terminal transition).
+            self._swap_epoch += 1
+        if self._on_swap is not None:
+            try:
+                self._on_swap(prev_engine)
+            except Exception as err:
+                log.error("on_swap hook failed", err)
+        log.info(
+            "forced rollback to last-known-good",
+            key=self.instance_key,
+            rolled_back_from=bad_uuid,
+            rolled_back_to=prev_uuid,
+        )
+        return {
+            "tenant": self.instance_key,
+            "rolled_back_from": bad_uuid,
+            "rolled_back_to": prev_uuid,
+            "ring_remaining": len(self.ring),
+        }
 
     # -- internals -----------------------------------------------------------
 

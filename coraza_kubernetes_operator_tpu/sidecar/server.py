@@ -13,8 +13,12 @@ Two serving surfaces, mirroring how the reference data plane is consumed
   the high-throughput path for replayers and load generators, and the
   shape the benchmarks use.
 
-Control endpoints: ``/waf/v1/healthz`` (ready once a ruleset is loaded) and
-``/waf/v1/stats`` (batcher + reloader counters).
+Control endpoints: ``/waf/v1/healthz`` (liveness: the process answers),
+``/waf/v1/readyz`` (readiness: 503 while no ruleset is loaded or the
+serving mode is ``broken`` — Kubernetes stops routing to a dead sidecar
+instead of feeding it 500s), ``/waf/v1/stats`` (batcher + reloader +
+rollout counters), and ``POST /waf/v1/rollback`` (force the serving
+engine back to the last-known-good ring entry — docs/ROLLOUT.md).
 
 ``failurePolicy`` (reference ``api/v1alpha1/engine_types.go:153-166``, which
 the reference stores but never forwards — SURVEY §5): with no loaded
@@ -47,6 +51,7 @@ from .batcher import (
 )
 from .degraded import (
     BREAKER_CODES,
+    MODE_BROKEN,
     MODE_CODES,
     BreakerOpen,
     CircuitBreaker,
@@ -54,6 +59,7 @@ from .degraded import (
     Overloaded,
 )
 from .reloader import DEFAULT_POLL_INTERVAL_S
+from .rollout import RolloutConfig, RolloutManager
 from .tenants import TENANT_HEADER, TenantManager
 
 log = get_logger("sidecar.server")
@@ -150,6 +156,23 @@ class SidecarConfig:
     # the cooldown before a half-open re-probe.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    # -- staged ruleset rollout (docs/ROLLOUT.md) ----------------------------
+    # Hot reloads stage a candidate in a budgeted background compile,
+    # shadow-verify it on mirrored live traffic, and promote only after N
+    # clean windows (auto-rollback on divergence/fault/latency). Disabling
+    # reverts to the legacy compile-gate-swap reload path.
+    rollout_enabled: bool = True
+    # None fields read their CKO_* env var (see sidecar/rollout.py):
+    # CKO_COMPILE_BUDGET_S, CKO_SHADOW_SAMPLE_RATE,
+    # CKO_SHADOW_PROMOTE_WINDOWS, CKO_SHADOW_DIVERGE_THRESHOLD,
+    # CKO_SHADOW_LATENCY_RATIO, CKO_SHADOW_IDLE_S, CKO_ROLLOUT_RING.
+    compile_budget_s: float | None = None
+    shadow_sample_rate: float | None = None
+    shadow_promote_windows: int | None = None
+    shadow_diverge_threshold: float | None = None
+    shadow_latency_ratio: float | None = None
+    shadow_idle_check_s: float | None = None
+    rollout_ring_depth: int | None = None
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -235,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == API_PREFIX + "healthz":
             self._handle_healthz()
+        elif path == API_PREFIX + "readyz":
+            self._handle_readyz()
         elif path == API_PREFIX + "stats":
             self._reply_json(200, self.sidecar.stats())
         elif path == API_PREFIX + "metrics":
@@ -262,6 +287,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if path == API_PREFIX + "evaluate":
             self._handle_bulk(body)
+        elif path == API_PREFIX + "rollback":
+            self._handle_rollback(body)
         elif path.startswith(API_PREFIX):
             self._reply_json(404, {"error": "not found"})
         else:
@@ -272,10 +299,51 @@ class _Handler(BaseHTTPRequestHandler):
     # -- handlers ------------------------------------------------------------
 
     def _handle_healthz(self) -> None:
-        if self.sidecar.ready():
-            self._reply(200, b"ok\n", {"Content-Type": "text/plain"})
-        else:
-            self._reply(503, b"no ruleset loaded\n", {"Content-Type": "text/plain"})
+        # Liveness only: the process is up and answering. Readiness
+        # (ruleset loaded, device/fallback path serviceable) moved to
+        # /waf/v1/readyz — a liveness probe that fails on "no ruleset
+        # yet" makes Kubernetes restart a healthy pod mid-compile.
+        self._reply(200, b"ok\n", {"Content-Type": "text/plain"})
+
+    def _handle_readyz(self) -> None:
+        if not self.sidecar.ready():
+            self._reply(
+                503, b"not ready: no ruleset loaded\n", {"Content-Type": "text/plain"}
+            )
+            return
+        mode = self.sidecar.serving_mode()
+        if mode == MODE_BROKEN:
+            # Device path broken (breaker open): even though the host
+            # fallback may still answer, pull this replica from rotation —
+            # healthy replicas serve at device speed; a broken one sheds
+            # under any real load.
+            self._reply(
+                503,
+                b"not ready: device path broken\n",
+                {"Content-Type": "text/plain"},
+            )
+            return
+        self._reply(200, f"ok mode={mode}\n".encode(), {"Content-Type": "text/plain"})
+
+    def _handle_rollback(self, body: bytes) -> None:
+        """Force the serving engine back to the previous last-known-good
+        ring entry (docs/ROLLOUT.md). Optional JSON body {"tenant": key};
+        default tenant otherwise. 409 when there is nothing to roll back
+        to (empty ring / unknown tenant)."""
+        tenant = None
+        if body:
+            try:
+                tenant = (json.loads(body.decode("utf-8")) or {}).get("tenant")
+            except (ValueError, AttributeError):
+                self._reply_json(400, {"error": "invalid rollback payload"})
+                return
+        result = self.sidecar.force_rollback(tenant)
+        if result is None:
+            self._reply_json(
+                409, {"error": "nothing to roll back to (last-known-good ring empty)"}
+            )
+            return
+        self._reply_json(200, {**result, "mode": self.sidecar.serving_mode(tenant)})
 
     def _deadline_s(self) -> float | None:
         """Absolute monotonic deadline from the X-CKO-Deadline-Ms header."""
@@ -482,6 +550,23 @@ class TpuEngineSidecar:
     def __init__(self, config: SidecarConfig, engine: WafEngine | None = None):
         self.config = config
         keys = [k.strip() for k in config.instance_key.split(",") if k.strip()]
+        # Staged ruleset rollout (docs/ROLLOUT.md): budgeted background
+        # candidate compiles, shadow-traffic verification against the
+        # serving engine, automatic rollback. One manager serves all
+        # tenants (one mirror router, one outcome ledger).
+        self.rollout: RolloutManager | None = None
+        if config.rollout_enabled:
+            self.rollout = RolloutManager(
+                RolloutConfig(
+                    compile_budget_s=config.compile_budget_s,
+                    sample_rate=config.shadow_sample_rate,
+                    promote_windows=config.shadow_promote_windows,
+                    diverge_threshold=config.shadow_diverge_threshold,
+                    latency_ratio=config.shadow_latency_ratio,
+                    idle_check_s=config.shadow_idle_check_s,
+                    ring_depth=config.rollout_ring_depth,
+                )
+            )
         self.tenants = TenantManager(
             cache_base_url=config.cache_base_url,
             tenant_keys=keys or ["default/ruleset"],
@@ -491,6 +576,7 @@ class TpuEngineSidecar:
             # its first device batch lands. Late-bound: self.degraded is
             # constructed below.
             on_swap=lambda engine: self._on_engine_swap(engine),
+            rollout=self.rollout,
         )
         if engine is not None:  # pre-seeded (tests / static rules)
             self.tenants.seed(self.tenants.default_tenant, engine)
@@ -501,6 +587,10 @@ class TpuEngineSidecar:
             phase_split=config.phase_split,
             pipeline_depth=config.pipeline_depth,
         )
+        if self.rollout is not None:
+            # Mirror collected windows into any shadowing candidate
+            # (cheap dict probe when no rollout is active).
+            self.batcher.on_window = self.rollout.mirror_window
         self.metrics = MetricsRegistry()
         self._m_requests = self.metrics.counter(
             "waf_requests_total", "Evaluated requests by action", ("action",)
@@ -642,6 +732,49 @@ class TpuEngineSidecar:
             "cko_rules_approximated_total",
             "Rules approximated in the device plan (default tenant)",
         ).set_function(lambda: float(self._compile_report_len("approximated")))
+        # -- staged ruleset rollout (docs/ROLLOUT.md) -----------------------
+        self.metrics.gauge(
+            "cko_rollout_state",
+            "Staged-rollout state of the default tenant (0 idle, 1 staged,"
+            " 2 shadowing, 3 promoted, 4 rolled_back, 5 failed)",
+        ).set_function(lambda: float(self._rollout_state_code()))
+        m_rollouts = self.metrics.gauge(
+            "cko_rollouts_total",
+            "Staged rollouts by terminal outcome (all tenants)",
+            ("outcome",),
+        )
+        for outcome in ("started", "promoted", "rolled_back", "failed"):
+            m_rollouts.set_function(
+                (lambda o: lambda: float(self._rollout_count(o)))(outcome),
+                outcome=outcome,
+            )
+        self.metrics.gauge(
+            "cko_rollout_shadow_windows_total",
+            "Live windows shadow-verified against rollout candidates",
+        ).set_function(
+            lambda: float(self._rollout_shadow_total("windows"))
+        )
+        self.metrics.gauge(
+            "cko_rollout_shadow_diverged_total",
+            "Shadowed requests whose candidate verdict diverged",
+        ).set_function(
+            lambda: float(self._rollout_shadow_total("diverged_requests"))
+        )
+        self.metrics.gauge(
+            "cko_rollout_shadow_dropped_total",
+            "Mirror windows dropped because a shadow queue was full",
+        ).set_function(
+            lambda: float(self._rollout_shadow_total("dropped_windows"))
+        )
+        self.metrics.gauge(
+            "cko_rollback_forced_total",
+            "Operator-forced rollbacks via POST /waf/v1/rollback",
+        ).set_function(lambda: float(self.tenants.total_rollbacks_forced))
+        self.metrics.gauge(
+            "cko_compile_inflight",
+            "XLA compiles currently running (includes abandoned"
+            " budget-blown rollout candidates)",
+        ).set_function(lambda: float(EXEC_CACHE.inflight))
         self.batcher.on_engine_error = (
             lambda _engine, err: self.degraded.record_device_failure(err)
         )
@@ -726,6 +859,27 @@ class TpuEngineSidecar:
     def serving_mode(self, tenant: str | None = None) -> str:
         """cold | fallback | promoted | broken (for the given tenant)."""
         return self.degraded.mode_for(self.tenants.engine_for(tenant))
+
+    # -- staged rollout helpers ---------------------------------------------
+
+    def force_rollback(self, tenant: str | None = None) -> dict | None:
+        """POST /waf/v1/rollback: swap the tenant's serving engine back to
+        the last-known-good ring's previous entry, aborting any in-flight
+        rollout for it."""
+        return self.tenants.force_rollback(tenant)
+
+    def _rollout_state_code(self) -> int:
+        if self.rollout is None:
+            return 0
+        return self.rollout.state_code(self.tenants.default_tenant or "")
+
+    def _rollout_count(self, outcome: str) -> int:
+        return getattr(self.rollout, outcome, 0) if self.rollout is not None else 0
+
+    def _rollout_shadow_total(self, field: str) -> int:
+        if self.rollout is None:
+            return 0
+        return self.rollout.shadow_totals().get(field, 0)
 
     def count_failopen(self, n: int = 1) -> None:
         self._m_failopen.inc(n)
@@ -1047,6 +1201,12 @@ class TpuEngineSidecar:
                 "cko_analysis_findings_total": self.tenants.analysis_counts(),
                 "rejected_reloads": self.tenants.total_analyze_rejected,
             },
+            "rollout": (
+                {"enabled": True, **self.rollout.stats()}
+                if self.rollout is not None
+                else {"enabled": False}
+            ),
+            "rollbacks_forced": self.tenants.total_rollbacks_forced,
             "cko_rules_skipped_total": self._compile_report_len("skipped"),
             "cko_rules_approximated_total": self._compile_report_len("approximated"),
         }
@@ -1083,6 +1243,8 @@ class TpuEngineSidecar:
             self._serve_thread.join(timeout=10)
         self._httpd.server_close()
         self.degraded.stop()
+        if self.rollout is not None:
+            self.rollout.stop()
         self.batcher.stop()
         self.tenants.stop()
         if self.audit is not None:

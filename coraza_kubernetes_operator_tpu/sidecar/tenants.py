@@ -87,6 +87,7 @@ class TenantManager:
         poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
         engine_factory=WafEngine,
         on_swap=None,
+        rollout=None,
     ):
         self.cache_base_url = cache_base_url
         self.poll_interval_s = poll_interval_s
@@ -103,6 +104,10 @@ class TenantManager:
             else SharedEngineFactory(engine_factory)
         )
         self._on_swap = on_swap  # forwarded to every tenant's reloader
+        # Staged-rollout manager (sidecar/rollout.py), shared across
+        # tenants: one shadow-mirror router and one set of outcome
+        # counters; each tenant's reloader stages its own candidates.
+        self._rollout = rollout
         for key in tenant_keys:
             self.add(key)
         # Normalized like the reloader keys, so the two never diverge.
@@ -119,6 +124,7 @@ class TenantManager:
                 poll_interval_s=self.poll_interval_s,
                 engine_factory=self._engine_factory,
                 on_swap=self._on_swap,
+                rollout=self._rollout,
             )
 
     def seed(self, key: str, engine: WafEngine) -> None:
@@ -154,6 +160,20 @@ class TenantManager:
         factory = self._engine_factory
         return factory.dedup_hits if isinstance(factory, SharedEngineFactory) else 0
 
+    def force_rollback(self, key: str | None = None) -> dict | None:
+        """Operator-forced rollback for one tenant (default tenant when
+        ``key`` is None). Returns the swap summary or None when nothing
+        to roll back to (unknown tenant / empty ring)."""
+        key = (key or self.default_tenant or "").strip("/")
+        with self._lock:
+            reloader = self._reloaders.get(key)
+        return reloader.force_rollback() if reloader is not None else None
+
+    @property
+    def total_rollbacks_forced(self) -> int:
+        with self._lock:
+            return sum(r.rollbacks_forced for r in self._reloaders.values())
+
     def stats(self) -> dict:
         with self._lock:
             reloaders = dict(self._reloaders)
@@ -168,6 +188,8 @@ class TenantManager:
                 "analysis": (
                     r.analysis.counts() if r.analysis is not None else None
                 ),
+                "rollbacks_forced": r.rollbacks_forced,
+                "lkg_ring": r.ring.uuids(),
             }
             for key, r in reloaders.items()
         }

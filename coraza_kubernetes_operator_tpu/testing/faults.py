@@ -21,6 +21,14 @@ Knobs (all default off):
   stream (default 0).
 - ``CKO_FAULT_CACHE_OUTAGE=1``: every cache-server poll fails with a
   connection error — simulating a cache-server outage mid-reload.
+- ``CKO_FAULT_SHADOW_DIVERGE_RATE=<0..1>``: each shadow-verification
+  window of a staged rollout (``sidecar/rollout.py``) is forced to read
+  as diverged with this probability — simulating a
+  semantically-wrong-but-analyzer-clean candidate whose verdicts drift
+  from the serving engine's. Drives the auto-rollback invariant in
+  tests/the chaos job. Seeded separately
+  (``CKO_FAULT_SHADOW_DIVERGE_SEED``) so it never perturbs the
+  device-error stream's reproducibility.
 
 The hooks are called from production code (``engine/waf.py``,
 ``sidecar/reloader.py``) and are no-ops (a few ns of ``os.environ``
@@ -95,6 +103,32 @@ def on_device_dispatch(warmed: bool) -> None:
             time.sleep(stall)
     if injected_device_error():
         raise DeviceFault("injected device error (CKO_FAULT_DEVICE_ERROR_RATE)")
+
+
+_shadow_rng_lock = threading.Lock()
+_shadow_rng: random.Random | None = None
+_shadow_rng_seed: int | None = None
+
+
+def injected_shadow_diverge() -> bool:
+    """True when this shadow window should be scored as diverged
+    (``CKO_FAULT_SHADOW_DIVERGE_RATE``; consumes one draw from its own
+    seeded PRNG — the device-error stream stays untouched)."""
+    global _shadow_rng, _shadow_rng_seed
+    try:
+        rate = float(os.environ.get("CKO_FAULT_SHADOW_DIVERGE_RATE", "0") or 0)
+    except ValueError:
+        return False
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    seed = int(os.environ.get("CKO_FAULT_SHADOW_DIVERGE_SEED", "0"))
+    with _shadow_rng_lock:
+        if _shadow_rng is None or seed != _shadow_rng_seed:
+            _shadow_rng = random.Random(seed)
+            _shadow_rng_seed = seed
+        return _shadow_rng.random() < rate
 
 
 def cache_outage_active() -> bool:

@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Chaos smoke (ISSUE 6 CI satellite): the sidecar under a fault matrix.
+
+Boots ONE sidecar + cache server on the CPU backend and walks it through
+the fault-injection harness (``testing/faults.py``) end to end:
+
+1. **clean rollout** — a new ruleset version stages, shadow-verifies on
+   live traffic, and promotes;
+2. **compile stall + blown budget** (``CKO_FAULT_COMPILE_STALL_S`` over
+   ``CKO_COMPILE_BUDGET_S``) — the rollout records *failed*, polls keep
+   flowing, the serving engine never flinches;
+3. **shadow divergence** (``CKO_FAULT_SHADOW_DIVERGE_RATE=1``) — the
+   staged candidate auto-rolls back; serving verdicts stay correct;
+4. **device fault storm** (``CKO_FAULT_DEVICE_ERROR_RATE=1``) — the
+   breaker opens, mode goes ``broken``, the host fallback keeps
+   answering, ``/waf/v1/readyz`` reports not-ready; storm over, the
+   half-open probe re-promotes;
+5. **cache outage** (``CKO_FAULT_CACHE_OUTAGE=1``) — polls fail and back
+   off; outage clears and polling resumes.
+
+Throughout, a background traffic storm asserts every response is a real
+verdict (200/403, correct per request) — never a blank 500 — and at the
+end the process must be in a sane serving mode with zero in-flight
+windows and no hung worker threads.
+
+Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+EVIL_MONKEY = (
+    'SecRule ARGS|REQUEST_URI "@contains evilmonkey" '
+    '"id:3001,phase:2,deny,status:403"\n'
+)
+# v2/v3 add rules the storm traffic never triggers: shadow verification
+# must see ZERO genuine divergence, so scenario 3's rollback is provably
+# the injected fault, not a traffic artifact.
+EVIL_TIGER = (
+    'SecRule ARGS|REQUEST_URI "@contains eviltiger" '
+    '"id:3002,phase:2,deny,status:403"\n'
+)
+EVIL_PANDA = (
+    'SecRule ARGS|REQUEST_URI "@contains evilpanda" '
+    '"id:3003,phase:2,deny,status:403"\n'
+)
+KEY = "default/ruleset"
+
+
+def _fail(stage: str, **detail) -> int:
+    print(json.dumps({"chaos_smoke": "FAIL", "stage": stage, **detail}))
+    return 1
+
+
+def _http(port, path, timeout=30):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait(predicate, timeout_s=60.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def main() -> int:
+    # The harness knobs are read at use time; make sure none leak in.
+    for var in list(os.environ):
+        if var.startswith("CKO_FAULT_"):
+            del os.environ[var]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO))
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+
+    configure_persistent_cache(
+        os.environ.get("CKO_COMPILE_CACHE_DIR") or str(REPO / ".jax_bench_cache")
+    )
+    from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+    from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+
+    cache = RuleSetCache()
+    cache.put(KEY, BASE + EVIL_MONKEY)
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1",
+            port=0,
+            cache_base_url=f"http://127.0.0.1:{srv.port}",
+            instance_key=KEY,
+            poll_interval_s=0.1,
+            compile_budget_s=120.0,
+            shadow_promote_windows=2,
+            shadow_sample_rate=1.0,
+            shadow_idle_check_s=0.5,
+            breaker_threshold=3,
+            breaker_cooldown_s=0.5,
+        )
+    )
+    sc.start()
+
+    stop = threading.Event()
+    bad: list = []
+
+    def storm():
+        i = 0
+        while not stop.is_set():
+            attack = i % 2 == 0
+            path = f"/?pet=evilmonkey&i={i}" if attack else f"/?q=fine&i={i}"
+            try:
+                status, body = _http(sc.port, path)
+            except Exception as err:  # dropped connection = a failure too
+                bad.append((path, f"{type(err).__name__}: {err}"))
+                i += 1
+                continue
+            want = 403 if attack else 200
+            if status != want or not body:
+                bad.append((path, status, body[:80]))
+            i += 1
+            time.sleep(0.005)
+
+    storm_thread = threading.Thread(target=storm, daemon=True)
+    rollout = sc.rollout
+    try:
+        if not _wait(lambda: sc.serving_mode() == "promoted", 120):
+            return _fail("boot", mode=sc.serving_mode())
+        storm_thread.start()
+
+        # 1. Clean rollout: v2 stages, shadow-verifies the storm, promotes.
+        cache.put(KEY, BASE + EVIL_MONKEY + EVIL_TIGER)
+        if not _wait(lambda: rollout.promoted >= 1, 60):
+            return _fail("clean_rollout", rollout=rollout.stats())
+        if _http(sc.port, "/?pet=eviltiger")[0] != 403:
+            return _fail("clean_rollout", detail="v2 rule not live after promote")
+
+        # 2. Compile stall over budget: rollout fails, serving untouched.
+        engine_before = sc.tenants.engine_for(None)
+        sc.rollout.config.compile_budget_s = 1.0
+        os.environ["CKO_FAULT_COMPILE_STALL_S"] = "30"
+        polls_before = sc.reloader.polls
+        cache.put(KEY, BASE + EVIL_MONKEY + EVIL_TIGER + EVIL_PANDA)
+        if not _wait(lambda: rollout.failed >= 1, 30):
+            return _fail("compile_stall", rollout=rollout.stats())
+        if sc.tenants.engine_for(None) is not engine_before:
+            return _fail("compile_stall", detail="serving engine was perturbed")
+        if not _wait(lambda: sc.reloader.polls > polls_before + 3, 10):
+            return _fail("compile_stall", detail="poll loop stalled")
+        del os.environ["CKO_FAULT_COMPILE_STALL_S"]
+        sc.rollout.config.compile_budget_s = 120.0
+
+        # 3. Shadow divergence: the next candidate auto-rolls back.
+        os.environ["CKO_FAULT_SHADOW_DIVERGE_RATE"] = "1.0"
+        os.environ["CKO_ROLLOUT_RETRY_S"] = "0.5"  # unlatch the stalled uuid
+        if not _wait(lambda: rollout.rolled_back >= 1, 60):
+            return _fail("shadow_divergence", rollout=rollout.stats())
+        if sc.tenants.engine_for(None) is not engine_before:
+            return _fail("shadow_divergence", detail="diverging candidate promoted")
+        del os.environ["CKO_FAULT_SHADOW_DIVERGE_RATE"]
+        del os.environ["CKO_ROLLOUT_RETRY_S"]
+
+        # 4. Device fault storm: breaker opens, fallback serves, readyz
+        # pulls the replica; storm over, the half-open probe re-promotes.
+        os.environ["CKO_FAULT_DEVICE_ERROR_RATE"] = "1.0"
+        if not _wait(lambda: sc.serving_mode() == "broken", 60):
+            return _fail("device_storm", mode=sc.serving_mode())
+        if _http(sc.port, "/waf/v1/readyz")[0] != 503:
+            return _fail("device_storm", detail="readyz still ready while broken")
+        status, _ = _http(sc.port, "/?pet=evilmonkey&storm=1")
+        if status != 403:
+            return _fail("device_storm", detail=f"fallback answered {status}")
+        os.environ["CKO_FAULT_DEVICE_ERROR_RATE"] = "0"
+        if not _wait(lambda: sc.serving_mode() == "promoted", 60):
+            return _fail("device_storm_recovery", mode=sc.serving_mode())
+        if _http(sc.port, "/waf/v1/readyz")[0] != 200:
+            return _fail("device_storm_recovery", detail="readyz not ready again")
+
+        # 5. Cache outage: polls fail + back off; clears and resumes.
+        os.environ["CKO_FAULT_CACHE_OUTAGE"] = "1"
+        failures_before = sc.reloader.poll_failures
+        if not _wait(lambda: sc.reloader.poll_failures > failures_before + 2, 30):
+            return _fail("cache_outage", detail="poll failures not recorded")
+        os.environ["CKO_FAULT_CACHE_OUTAGE"] = "0"
+        if not _wait(lambda: sc.reloader.consecutive_poll_failures == 0, 30):
+            return _fail("cache_outage_recovery", detail="polls never recovered")
+
+        stop.set()
+        storm_thread.join(timeout=10)
+        if storm_thread.is_alive():
+            return _fail("teardown", detail="storm thread hung")
+        if bad:
+            return _fail("verdicts", bad=bad[:5], total_bad=len(bad))
+        if sc.serving_mode() not in ("promoted", "fallback"):
+            return _fail("final_mode", mode=sc.serving_mode())
+        if not _wait(lambda: sc.batcher.inflight_windows() == 0, 30):
+            return _fail("teardown", detail="in-flight windows never drained")
+    finally:
+        stop.set()
+        sc.stop()
+        srv.stop()
+        for var in list(os.environ):
+            if var.startswith("CKO_FAULT_"):
+                del os.environ[var]
+
+    # Zero hung threads: after stop(), only the main thread (plus the
+    # interpreter's internals) may survive a grace period. Daemon worker
+    # threads that refuse to exit would show up here.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        hung = [
+            t
+            for t in threading.enumerate()
+            if t is not threading.main_thread()
+            and t.is_alive()
+            and not t.name.startswith(("pydevd", "Dummy", "ThreadPoolExecutor"))
+            # The budget-abandoned scenario-2 candidate may still be
+            # sleeping out its injected 30s stall; it is discarded and
+            # exits on wake — everything else must be gone.
+            and not t.name.startswith("cko-rollout-")
+        ]
+        if not hung:
+            break
+        time.sleep(0.2)
+    else:
+        return _fail("threads", hung=[t.name for t in hung])
+
+    print(
+        json.dumps(
+            {
+                "chaos_smoke": "PASS",
+                "final_mode": sc.serving_mode(),
+                "rollouts": rollout.stats() if rollout else None,
+                "storm_requests_bad": len(bad),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

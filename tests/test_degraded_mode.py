@@ -139,13 +139,18 @@ def test_reloader_backoff_and_cache_outage(monkeypatch):
         assert not r.poll_once()
         assert r.poll_failures == 2
         assert r.consecutive_poll_failures == 2
-        # Failure backoff retries well before the 15s poll interval.
-        assert r.next_wait_s() <= 1.0
+        # Failure backoff retries well before the 15s poll interval
+        # (base 1.0s for two consecutive failures, ±20% jitter).
+        assert r.next_wait_s() <= 1.2
         monkeypatch.setenv("CKO_FAULT_CACHE_OUTAGE", "0")
         assert r.poll_once()  # outage over: the ruleset loads
         assert r.engine is not None
         assert r.consecutive_poll_failures == 0
-        assert r.next_wait_s() == 15.0
+        # Healthy waits are the poll interval ±20% jitter (thundering-herd
+        # decorrelation), and genuinely vary call to call.
+        waits = [r.next_wait_s() for _ in range(16)]
+        assert all(15.0 * 0.8 <= w <= 15.0 * 1.2 for w in waits), waits
+        assert len({round(w, 6) for w in waits}) > 1
     finally:
         srv.stop()
 

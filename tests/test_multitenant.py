@@ -36,6 +36,11 @@ def stack():
             port=0,
             max_batch_delay_ms=0.5,
             trust_tenant_header=True,  # tests model a trusted fronting proxy
+            # These tests detect reloads by polling the CHANGED verdicts,
+            # so their live traffic is 100% divergent by construction —
+            # shadow gating would (correctly) roll the update back. Keep
+            # the budgeted background compile, skip shadow verification.
+            shadow_promote_windows=0,
         )
     )
     side.start()

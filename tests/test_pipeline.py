@@ -351,6 +351,66 @@ def test_stop_drains_inflight_windows_deterministically():
             assert "batcher stopped" in str(err)
 
 
+# -- fault harness under pipelining (ISSUE 6 satellite) -----------------------
+
+
+def test_device_faults_mid_stream_fail_only_their_windows(monkeypatch):
+    """``CKO_FAULT_DEVICE_ERROR_RATE`` firing with depth >= 2 in flight
+    (the PR 1 harness predates pipelining): a faulted window fails ONLY
+    its own futures and feeds the breaker hook; neighbouring windows
+    still verdict, the collector never deadlocks, and a clean burst
+    afterwards serves normally."""
+    monkeypatch.setenv("CKO_FAULT_COMPILE_STALL_S", "0")
+    monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "0")
+    from coraza_kubernetes_operator_tpu.testing.faults import DeviceFault
+
+    engine = WafEngine(BASE + EVIL_MONKEY)
+    engine.evaluate([HttpRequest(uri="/?warm=1")])  # warm: stall knob moot
+    b = MicroBatcher(
+        lambda: engine, max_batch_size=1, max_batch_delay_ms=0.0, pipeline_depth=2
+    )
+    breaker_errors: list[BaseException] = []
+    successes: list[int] = []
+    b.on_engine_error = lambda _e, err: breaker_errors.append(err)
+    b.on_engine_success = lambda _e: successes.append(1)
+    b.start()
+    try:
+        # Mixed-fate stream: a seeded 0.5 error rate across 24 one-request
+        # windows, submitted fast enough that windows genuinely overlap.
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_SEED", "11")
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "0.5")
+        futs = [b.submit(HttpRequest(uri=f"/?pet=evilmonkey&i={i}")) for i in range(24)]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", f.result(timeout=60)))
+            except DeviceFault as err:
+                outcomes.append(("fault", err))
+        # Every future resolved (no deadlock), both fates occurred, and
+        # faulted windows never leaked a verdict.
+        fates = {kind for kind, _ in outcomes}
+        assert fates == {"ok", "fault"}, fates
+        for kind, v in outcomes:
+            if kind == "ok":
+                assert v.interrupted and v.status == 403
+        assert breaker_errors and all(
+            isinstance(e, DeviceFault) for e in breaker_errors
+        )
+        assert successes  # surviving windows fed the breaker's reset side
+        # Storm over: the pipeline is still alive and serves a clean burst.
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "0")
+        clean = [b.submit(HttpRequest(uri=f"/?q=fine&i={i}")) for i in range(6)]
+        for f in clean:
+            assert f.result(timeout=60).interrupted is False
+        assert _wait(lambda: b.inflight_windows() == 0, timeout_s=10)
+    finally:
+        monkeypatch.setenv("CKO_FAULT_DEVICE_ERROR_RATE", "0")
+        b.stop()
+    # stop() drained deterministically: a wedged collector would have
+    # left in-flight windows (and hung the join inside stop()).
+    assert b.inflight_windows() == 0
+
+
 # -- deadline expiry + breaker open with windows in flight --------------------
 
 

@@ -248,8 +248,13 @@ def test_failure_policy_fail_closed(cache_server):
         status, headers, _ = _http(sc, "/anything")
         assert status == 503
         assert headers["x-waf-action"] == "fail-closed"
+        # healthz is LIVENESS (process up): 200 even with nothing loaded;
+        # readyz is the routing gate and reports not-ready.
         status, _, _ = _http(sc, "/waf/v1/healthz")
+        assert status == 200
+        status, _, body = _http(sc, "/waf/v1/readyz")
         assert status == 503
+        assert b"no ruleset" in body
     finally:
         sc.stop()
 
