@@ -59,6 +59,10 @@ pipeline.smoke:  ## Host/device overlap gate: pipelined >= 1.2x sync, verdicts i
 chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage.
 	$(PYTHON) hack/chaos_smoke.py
 
+.PHONY: compile.smoke
+compile.smoke:  ## Cold-compile ceiling gate: crs-lite wall + minimized-state + signature caps.
+	$(PYTHON) hack/compile_time_smoke.py
+
 # bench.warm populates .jax_bench_cache with the FINAL compiler's HLO so
 # the driver's timed run hits a warm XLA cache (VERDICT r3 item 1d). Runs
 # every config once with minimal iters; throughput output is discarded.

@@ -73,45 +73,90 @@ def _dedup_stats(tiers, n_req: int) -> dict:
     }
 
 
-def _bench_serve_fn(model, tiers, numvals, masks=None, n_chunks=1):
-    """The serve-loop computation, with the MODEL AS AN OPERAND (not a
-    closure constant): the compiled executable is a function of the
-    shape signature only, so same-layout configs/processes reuse it
-    through the executable cache + the persistent disk cache."""
+def _bench_match_fn(
+    model, data, lengths, variant_data, variant_lengths, mask=None, n_chunks=1
+):
+    """ONE tier's matcher stage with the bench chunk loop inside, model
+    as an operand (the split-dispatch twin of the old monolithic serve
+    fn): returns [n_chunks, U, PB] packed hit rows. Byte 0 is perturbed
+    per chunk so lax.map cannot hoist the scan as loop-invariant."""
     import jax
     import jax.numpy as jnp
 
-    from coraza_kubernetes_operator_tpu.models.waf_model import eval_waf_tiered
+    from coraza_kubernetes_operator_tpu.models.waf_model import match_tier_packed
 
     def chunk(i):
-        # Perturb EVERY tier's buffer: lax.map hoists loop-invariant
-        # subgraphs, so an untouched tier would be evaluated once per
-        # dispatch instead of once per chunk and the number would
-        # measure only the perturbed tier's marginal work.
-        perturbed = tuple(
-            (t[0].at[0, 0].set(i.astype(jnp.uint8)),) + tuple(t[1:])
-            for t in tiers
+        return match_tier_packed.__wrapped__(
+            model,
+            data.at[0, 0].set(i.astype(jnp.uint8)),
+            lengths,
+            variant_data,
+            variant_lengths,
+            mask=mask,
         )
-        out = eval_waf_tiered.__wrapped__(model, perturbed, numvals, masks=masks)
+
+    return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
+
+
+def _bench_post_fn(model, tier_hits, pairs, numvals, max_phase=2, n_chunks=1):
+    """The post stage over every chunk's packed hits (tuple of
+    [n_chunks, U, PB] arrays, one per tier): per chunk, the same
+    unpack -> expand -> post_match tail as
+    ``models/waf_model.eval_post_tiered``, reduced to the per-chunk
+    interrupted count the bench reads."""
+    import jax
+    import jax.numpy as jnp
+
+    from coraza_kubernetes_operator_tpu.models.waf_model import (
+        _unpack_hit_rows,
+        post_match,
+    )
+
+    g = model.e_lg.shape[0]
+
+    def chunk(i):
+        hits, k1s, k2s, k3s, rids = [], [], [], [], []
+        for hp, (k1, k2, k3, rid, uid) in zip(tier_hits, pairs):
+            hu = _unpack_hit_rows(hp[i], g)
+            hits.append(jnp.take(hu, uid, axis=0))
+            k1s.append(k1)
+            k2s.append(k2)
+            k3s.append(k3)
+            rids.append(rid)
+        out = post_match(
+            model,
+            jnp.concatenate(hits, axis=0),
+            jnp.concatenate(k1s),
+            jnp.concatenate(k2s),
+            jnp.concatenate(k3s),
+            jnp.concatenate(rids),
+            numvals,
+            max_phase,
+        )
         return out["interrupted"].sum()
 
     return jax.lax.map(chunk, jnp.arange(n_chunks, dtype=jnp.int32))
 
 
-_BENCH_SERVE = None  # jitted lazily (jax import must stay inside configs)
+# jitted lazily (jax import must stay inside configs)
+_BENCH_MATCH = None
+_BENCH_POST = None
 
 
-def _bench_serve():
-    global _BENCH_SERVE
-    if _BENCH_SERVE is None:
+def _bench_split():
+    global _BENCH_MATCH, _BENCH_POST
+    if _BENCH_MATCH is None:
         import functools
 
         import jax
 
-        _BENCH_SERVE = functools.partial(
-            jax.jit, static_argnames=("masks", "n_chunks")
-        )(_bench_serve_fn)
-    return _BENCH_SERVE
+        _BENCH_MATCH = functools.partial(
+            jax.jit, static_argnames=("mask", "n_chunks")
+        )(_bench_match_fn)
+        _BENCH_POST = functools.partial(
+            jax.jit, static_argnames=("max_phase", "n_chunks")
+        )(_bench_post_fn)
+    return _BENCH_MATCH, _BENCH_POST
 
 
 def _serve_throughput(
@@ -121,18 +166,23 @@ def _serve_throughput(
     """One-dispatch-many-chunks serving measurement. Returns dict.
 
     Uses the production row-level length-tier path (``tier_tensors`` +
-    ``eval_waf_tiered``): tensorize once, rows split by length class,
-    each tier's matcher at its own buffer width, one global post_match.
-    Dispatch rides the shape-canonical executable cache
+    the SPLIT per-tier dispatch): tensorize once, rows split by length
+    class, one independently-compiled matcher executable per tier
+    (chunk loop inside) plus one post-stage executable — compiled in
+    PARALLEL, smallest-first, through ``engine/tier_compile.py``, so
+    the reported ``compile_s`` is the cold wall the collapsed path
+    actually pays. Dispatch rides the shape-canonical executable cache
     (``engine/compile_cache.py``); ``measure_warm`` additionally times a
-    from-scratch recompile of the same signature (served from the
+    from-scratch recompile of the same signatures (served from the
     persistent disk cache → the cost a SECOND process pays) as
-    ``warm_compile_s`` — costs one extra trace, so it stays off for the
+    ``warm_compile_s`` — costs extra traces, so it stays off for the
     minutes-to-trace CRS-scale configs."""
     import jax
+    import numpy as np
 
     from coraza_kubernetes_operator_tpu.corpus import synthetic_requests
     from coraza_kubernetes_operator_tpu.engine.compile_cache import EXEC_CACHE
+    from coraza_kubernetes_operator_tpu.engine.tier_compile import TIER_COMPILER
 
     m = engine.model
     if requests is None:
@@ -149,14 +199,47 @@ def _serve_throughput(
     dev_tiers = jax.device_put(tiers)
     dev_nv = jax.device_put(numvals)
 
-    serve = _bench_serve()
-    statics = {"masks": masks, "n_chunks": n_chunks}
+    serve_match, serve_post = _bench_split()
+    pb = (int(m.e_lg.shape[0]) + 7) // 8
+    pairs = tuple((t[2], t[3], t[4], t[5], t[8]) for t in dev_tiers)
+    match_specs = []
+    for i, t in enumerate(dev_tiers):
+        u, length = t[0].shape
+        match_specs.append(
+            (
+                f"match:{u}x{length}",
+                float(u) * float(length),
+                serve_match,
+                (m, t[0], t[1], t[6], t[7]),
+                {"mask": masks[i], "n_chunks": n_chunks},
+                {},
+            )
+        )
+    # Placeholder hit arrays: only shapes/dtypes enter the key and the
+    # lowered program, so warming with zeros mints exactly the post
+    # executable the live dispatch calls with real matcher output.
+    ph_hits = tuple(
+        np.zeros((n_chunks, t[0].shape[0], pb), dtype=np.uint8)
+        for t in dev_tiers
+    )
+    post_statics = {"max_phase": 2, "n_chunks": n_chunks}
+    post_spec = (
+        "post", 0.0, serve_post, (m, ph_hits, pairs, dev_nv), post_statics, {}
+    )
 
     def dispatch():
-        return EXEC_CACHE.call(serve, (m, dev_tiers, dev_nv), statics, {})
+        hits = tuple(
+            EXEC_CACHE.call(s[2], s[3], s[4], s[5]) for s in match_specs
+        )
+        return EXEC_CACHE.call(
+            serve_post, (m, hits, pairs, dev_nv), post_statics, {}
+        )
 
     cc0 = EXEC_CACHE.snapshot()
     t0 = time.perf_counter()
+    # Parallel smallest-first compile of every stage, then the first
+    # dispatch: compile_s is the cold-start wall to the first verdict.
+    TIER_COMPILER.compile_all(match_specs + [post_spec])
     out = dispatch()
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
@@ -198,15 +281,18 @@ def _serve_throughput(
         },
     }
     if measure_warm:
-        # Recompile the SAME signature from scratch: trace again, then
-        # time only the backend compile — with the persistent cache warm
+        # Recompile the SAME signatures from scratch: trace again, then
+        # time only the backend compiles — with the persistent cache warm
         # this deserializes from disk, which is exactly what a cold
         # process restart pays (the >=5x warm-vs-cold acceptance number).
         try:
-            lowered = serve.lower(m, dev_tiers, dev_nv, **statics)
-            t0 = time.perf_counter()
-            lowered.compile()
-            res["warm_compile_s"] = round(time.perf_counter() - t0, 3)
+            warm_s = 0.0
+            for s in match_specs + [post_spec]:
+                lowered = s[2].lower(*s[3], **s[4])
+                t0 = time.perf_counter()
+                lowered.compile()
+                warm_s += time.perf_counter() - t0
+            res["warm_compile_s"] = round(warm_s, 3)
         except Exception as err:
             res["warm_compile_s"] = None
             res["warm_compile_error"] = f"{type(err).__name__}: {err}"
@@ -446,34 +532,12 @@ def _config_3(iters, n_chunks, n_rules):
     eng = WafEngine(text)
     reqs, n_attacks = _ftw_replay_requests(4096)
 
-    # Degraded-mode partial (ISSUE 1): stream the host-fallback number
-    # FIRST, tagged "mode": "fallback" — the graded config must never
-    # again end a round as a bare {"error": "budget"} (five rounds of
-    # null verdicts because jit_serve compile alone ate the budget).
-    # Overwritten by the device number below if promotion lands in time.
-    fb_batch = min(int(os.environ.get("BENCH_FALLBACK_BATCH", "128")), len(reqs))
-    try:
-        t_fb = time.perf_counter()
-        fb_verdicts = eng.host_fallback.evaluate(reqs[:fb_batch])
-        fb_wall = time.perf_counter() - t_fb
-        fallback_partial = {
-            "mode": "fallback",
-            "req_per_s": round(fb_batch / fb_wall, 1),
-            "batch": fb_batch,
-            "blocked_in_batch": sum(1 for v in fb_verdicts if v.interrupted),
-            "rules_compiled": eng.compiled.n_rules,
-            "boundary": "host fallback evaluator (no device), single core",
-        }
-    except Exception as err:
-        fallback_partial = {
-            "mode": "fallback",
-            "error": f"{type(err).__name__}: {err}",
-        }
-    _emit(fallback_partial)
-
+    # No host-fallback salvage partial anymore: the cold-compile
+    # collapse (minimized DFAs + quantized shapes + parallel per-tier
+    # compiles) brought the cold path well inside the config budget, so
+    # the graded number is always the real device number.
     res = _serve_throughput(eng, 4096, iters, n_chunks, requests=reqs)
     res["mode"] = "tpu"
-    res["fallback_partial"] = fallback_partial
     res["rules_compiled"] = eng.compiled.n_rules
     res["groups"] = eng.compiled.n_groups
     res["seg_groups"] = sum(s.n_groups for s in eng.model.segs)
@@ -827,6 +891,7 @@ def _run_config(key: str) -> dict:
         EXEC_CACHE,
         configure_persistent_cache,
     )
+    from coraza_kubernetes_operator_tpu.engine.tier_compile import TIER_COMPILER
 
     # One shared persistent cache dir across bench children, ftw chunk
     # children, and the sidecar: BENCH_XLA_CACHE overrides, else the
@@ -877,6 +942,11 @@ def _run_config(key: str) -> dict:
             "total_misses": cc1[1] - cc0[1],
             "total_xla_compile_s": round(cc1[2] - cc0[2], 2),
             "persistent_dir": cache_dir if cache_dir != "0" else None,
+            # Split-dispatch footprint: resident executable signatures
+            # after the config, and per-label XLA seconds from the tier
+            # compiler (same naming as /waf/v1/stats compile_cache).
+            "signatures": len(EXEC_CACHE),
+            "tier_compile_s": TIER_COMPILER.stats(),
         }
     )
     return res

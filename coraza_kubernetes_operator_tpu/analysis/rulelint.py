@@ -58,7 +58,11 @@ from .redos import pattern_has_eda
 _REGEX_OPS = {"rx", "strmatch"}
 
 # DFA-product language-inclusion cap: pairs above this are skipped (the
-# cheap same-group check still applies to them).
+# cheap same-group check still applies to them). The group DFAs the
+# analyzer walks are Hopcroft-MINIMIZED (compiler/re_dfa.py applies
+# minimize() before tables are emitted), which both shrinks the product
+# space — more pairs land under the cap — and makes the inclusion
+# decision exact on the same automata the device actually runs.
 _MAX_INCLUSION_PRODUCT = 4000
 
 _TERMINAL_DECISIONS = {DEC_DENY, DEC_DROP, DEC_REDIRECT, DEC_ALLOW}

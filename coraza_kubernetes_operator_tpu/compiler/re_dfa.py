@@ -79,6 +79,9 @@ class DFA:
     # conv-segment decomposition (``compiler/segments.py``) before falling
     # back to scanning these tables.
     ast: object = None
+    # State count of the subset-construction automaton BEFORE minimization
+    # (0 = never minimized). Host metadata for CompileReport / metrics.
+    pre_min_states: int = 0
 
     @property
     def n_states(self) -> int:
@@ -87,6 +90,68 @@ class DFA:
     @property
     def n_classes(self) -> int:
         return int(self.trans.shape[1])
+
+    def minimize(self) -> "DFA":
+        """Hopcroft-equivalent state minimization plus byte-class re-merge.
+
+        Partition refinement over Mealy signatures: two states are merged
+        only when they agree on ``match_end``, on the full ``emit`` row,
+        and transition to pairwise-equivalent states — so ``search`` is
+        bit-identical on every input by construction. Implemented as
+        vectorized signature hashing (``np.unique`` over rows) iterated
+        to fixpoint, which computes the same coarsest partition Hopcroft
+        does in near-linear practical time. After state merging, byte
+        classes whose (trans, emit) columns became identical are merged
+        and ``classmap`` re-derived, shrinking both table axes.
+        """
+        trans, emit, me = self.trans, self.emit, self.match_end
+        n_states = int(trans.shape[0])
+        # Initial partition: Mealy outputs (match_end, emit row).
+        sig0 = np.concatenate(
+            [me[:, None].astype(np.int64), emit.astype(np.int64)], axis=1
+        )
+        _, block = np.unique(sig0, axis=0, return_inverse=True)
+        n_blocks = int(block.max()) + 1 if n_states else 0
+        while True:
+            sig = np.concatenate([block[:, None], block[trans]], axis=1)
+            _, new_block = np.unique(sig, axis=0, return_inverse=True)
+            n_new = int(new_block.max()) + 1 if n_states else 0
+            block = new_block
+            if n_new == n_blocks:
+                break
+            n_blocks = n_new
+        # Stable relabel: blocks numbered by first-occurrence state order,
+        # so the block containing state 0 is state 0 and equal automata
+        # minimize to byte-identical tables (cache determinism).
+        uniq, first = np.unique(block, return_index=True)
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(n_blocks, dtype=np.int64)
+        rank[uniq[order]] = np.arange(n_blocks)
+        new_of_state = rank[block]
+        reps = first[order]  # representative old state per new state
+        trans2 = new_of_state[trans[reps]].astype(np.int32)
+        emit2 = emit[reps]
+        me2 = me[reps]
+        # Byte-class merge: columns with identical behavior share a class.
+        colsig = np.concatenate(
+            [trans2.astype(np.int64), emit2.astype(np.int64)], axis=0
+        ).T  # [C, 2*S']
+        _, cinv = np.unique(colsig, axis=0, return_inverse=True)
+        n_cls = int(cinv.max()) + 1 if cinv.size else 0
+        cu, cfirst = np.unique(cinv, return_index=True)
+        corder = np.argsort(cfirst, kind="stable")
+        crank = np.empty(n_cls, dtype=np.int64)
+        crank[cu[corder]] = np.arange(n_cls)
+        creps = cfirst[corder]
+        return DFA(
+            trans=np.ascontiguousarray(trans2[:, creps]),
+            emit=np.ascontiguousarray(emit2[:, creps]),
+            match_end=me2,
+            classmap=crank[cinv[self.classmap]].astype(np.int32),
+            always_match=self.always_match,
+            ast=self.ast,
+            pre_min_states=self.pre_min_states or n_states,
+        )
 
     def search(self, data: bytes) -> bool:
         """Reference scalar scan — the oracle for kernel differential tests."""
@@ -228,6 +293,12 @@ def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object | None
         trans_rows.append(row_t)
         emit_rows.append(row_e)
 
+    # Minimize before the tables are emitted: subset construction over
+    # (positions, prev-ctx) routinely mints context-duplicated states, and
+    # every state removed here shrinks the stacked device banks and the
+    # flat-slot bins downstream (ISSUE 8 tentpole layer 1). literal_dfa
+    # and pm_dfa funnel through this same return, so all three entry
+    # points emit minimized tables.
     return DFA(
         trans=np.asarray(trans_rows, dtype=np.int32),
         emit=np.asarray(emit_rows, dtype=bool),
@@ -235,7 +306,7 @@ def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object | None
         classmap=classmap,
         always_match=nfa.always_matches,
         ast=ast,
-    )
+    ).minimize()
 
 
 # DFA construction cache: in-process memo + persistent on-disk pickle.
@@ -247,7 +318,7 @@ def compile_nfa_dfa(nfa: PositionNFA, max_states: int = 8192, ast: object | None
 # immediately. Keyed by (algo version, pattern, ci, max_states); the
 # AST is re-parsed on disk hits (parsing is ~free, and ASTs stay out of
 # the pickle format). CKO_DFA_CACHE=0 disables the disk layer.
-_DFA_ALGO_VERSION = 3
+_DFA_ALGO_VERSION = 4  # v4: minimized tables + pre_min_states in pickle
 _DFA_MEMO: dict[tuple, DFA] = {}
 
 
@@ -286,7 +357,7 @@ def compile_regex_dfa(
         path = os.path.join(cache_dir, f"{digest}.pkl")
         try:
             with open(path, "rb") as fh:
-                trans, emit, match_end, classmap, always = pickle.load(fh)
+                trans, emit, match_end, classmap, always, pre_min = pickle.load(fh)
             dfa = DFA(
                 trans=trans,
                 emit=emit,
@@ -294,6 +365,7 @@ def compile_regex_dfa(
                 classmap=classmap,
                 always_match=always,
                 ast=parse_regex(pattern, case_insensitive=case_insensitive),
+                pre_min_states=pre_min,
             )
             _DFA_MEMO[key] = dfa
             return dfa
@@ -312,7 +384,14 @@ def compile_regex_dfa(
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as fh:
                 pickle.dump(
-                    (dfa.trans, dfa.emit, dfa.match_end, dfa.classmap, dfa.always_match),
+                    (
+                        dfa.trans,
+                        dfa.emit,
+                        dfa.match_end,
+                        dfa.classmap,
+                        dfa.always_match,
+                        dfa.pre_min_states,
+                    ),
                     fh,
                 )
             os.replace(tmp, path)

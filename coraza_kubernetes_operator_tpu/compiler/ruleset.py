@@ -184,6 +184,16 @@ class CompileReport:
     skipped: list[tuple[int | None, str]] = field(default_factory=list)
     approximations: list[tuple[int | None, str]] = field(default_factory=list)
     const_eliminated: int = 0
+    # Cold-compile footprint (cko_dfa_states_{pre,post}_min_total):
+    # total DFA states across all group + kind-regex automata before and
+    # after Hopcroft minimization — the direct driver of stacked-bank
+    # size and XLA program size.
+    dfa_states_pre_min: int = 0
+    dfa_states_post_min: int = 0
+    # Distinct executable shape signatures this ruleset's engine has
+    # dispatched (cko_exec_signatures); written by the engine at dispatch
+    # time — 0 until the first batch.
+    exec_signatures: int = 0
 
     def skip(self, rule_id: int | None, reason: str) -> None:
         entry = (rule_id, reason)
@@ -1055,6 +1065,18 @@ class _Lowering:
         pipeline_device = [
             all(t in DEVICE_TRANSFORMS for t in p) for p in pipelines
         ]
+
+        # Minimization ledger: every automaton that reaches the device
+        # (group DFAs + kind-regex DFAs) records its pre/post state
+        # count — cko_dfa_states_{pre,post}_min_total and the CI
+        # compile-time smoke ceiling read these.
+        dfas = [g.dfa for g in self.groups] + list(
+            self.vocab._regex_dfas.values()
+        )
+        self.report.dfa_states_post_min = sum(d.n_states for d in dfas)
+        self.report.dfa_states_pre_min = sum(
+            (d.pre_min_states or d.n_states) for d in dfas
+        )
 
         return CompiledRuleSet(
             program=self.program,

@@ -1,0 +1,160 @@
+"""Per-tier parallel compile manager (cold-compile collapse).
+
+The split dispatch (``models/waf_model.match_tier_packed`` +
+``eval_post_tiered``) turns one monolithic executable into a handful of
+independent ones — one matcher per tier shape plus one post stage. This
+manager owns HOW those executables get compiled:
+
+- **Parallel**: compiles dispatch across a small thread pool. XLA
+  releases the GIL for the whole backend compile, so N tier compiles
+  genuinely overlap on N cores instead of serializing behind one
+  monolithic trace (``CKO_COMPILE_WORKERS``, default 4 — deliberately
+  not capped at the host's core count: tunnel-backed compiles are
+  remote/IO wait, so a 1-core host still overlaps them).
+- **Smallest-first**: pending compiles are submitted in ascending cost
+  order (post stage first — it is the cheapest and EVERY verdict needs
+  it), so the first tier able to serve from device is the smallest one,
+  not the largest. First-verdict latency after a cold start is gated on
+  the smallest group's compile; ``submitted`` records the order so tests
+  can pin that.
+- **Lazy-capable**: ``ensure`` is the non-blocking probe the lazy
+  dispatch mode uses — resident executables dispatch immediately, the
+  rest are enqueued and the caller routes the tier through the host
+  fallback until the executable lands (the degraded-mode promotion
+  pattern, applied per tier instead of per engine).
+
+All compiles flow through ``EXEC_CACHE.warm`` so residency, hit/miss
+accounting, and the persistent disk cache behave exactly as on the
+monolithic path. Per-label compile seconds feed ``cko_compile_tier_s``
+and the bench per-config breakdown.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..utils import get_logger
+from .compile_cache import EXEC_CACHE
+
+log = get_logger("engine.tier_compile")
+
+# A compile spec is (label, cost, jitted, args, static_kwargs, dyn_kwargs):
+# label is the stable human name ("post", "match:1024x64"), cost the
+# smallest-first sort key (~rows x width; 0 for the post stage).
+
+
+def spec_key(spec) -> tuple:
+    """The EXEC_CACHE key a spec's dispatch will use (same composition
+    as ``ExecutableCache.call``/``warm``: the ``cached`` dyn kwarg rides
+    the key because its shapes change the trace)."""
+    _label, _cost, jitted, args, statics, dyn = spec
+    return EXEC_CACHE.key_for(jitted, args + (dyn.get("cached"),), statics)
+
+
+class TierCompiler:
+    """Thread-pooled, smallest-first compilation of tier executables."""
+
+    def __init__(self, workers: int | None = None):
+        self._lock = threading.Lock()
+        self._pool: ThreadPoolExecutor | None = None
+        self._workers = workers
+        self._inflight: dict[tuple, object] = {}  # key -> Future
+        # label -> cumulative XLA wall seconds spent minting executables
+        # with that label (cko_compile_tier_s; bench per-config records).
+        self.tier_s: dict[str, float] = {}
+        # (label, cost) in submission order — smallest-first is the
+        # contract (tests/test_lazy_tiers.py pins it).
+        self.submitted: list[tuple[str, float]] = []
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            # NOT capped at cpu_count: backend compiles release the GIL
+            # and — through the axon tunnel — are mostly remote/IO wait,
+            # so even a 1-core host overlaps them. Serial tunnel compiles
+            # of a 5-executable split were exactly the cold wall the
+            # split was built to collapse.
+            workers = self._workers or int(
+                os.environ.get("CKO_COMPILE_WORKERS", "0")
+            ) or 4
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, workers),
+                thread_name_prefix="cko-tier-compile",
+            )
+        return self._pool
+
+    def resident(self, spec) -> bool:
+        """Probe without counting a cache hit (the pre-warm peek)."""
+        return EXEC_CACHE._lookup(spec_key(spec), count_hit=False) is not None
+
+    def _compile_one(self, key: tuple, spec) -> bool:
+        label, _cost, jitted, args, statics, dyn = spec
+        t0 = time.perf_counter()
+        try:
+            minted = EXEC_CACHE.warm(jitted, args, statics, dyn)
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+        if minted:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.tier_s[label] = self.tier_s.get(label, 0.0) + dt
+        return minted
+
+    def _submit(self, spec) -> object | None:
+        """Enqueue one spec (deduped on key). Returns the Future, or
+        None when the executable is already resident."""
+        key = spec_key(spec)
+        if EXEC_CACHE._lookup(key, count_hit=False) is not None:
+            return None
+        with self._lock:
+            fut = self._inflight.get(key)
+            if fut is None:
+                self.submitted.append((spec[0], float(spec[1])))
+                fut = self._ensure_pool().submit(self._compile_one, key, spec)
+                self._inflight[key] = fut
+        return fut
+
+    def ensure(self, spec) -> bool:
+        """Non-blocking: True when the spec's executable is resident and
+        can dispatch now; otherwise enqueue its compile (idempotent) and
+        return False so the caller routes through the host fallback."""
+        if self.resident(spec):
+            return True
+        self._submit(spec)
+        return False
+
+    def compile_all(self, specs) -> int:
+        """Blocking parallel compile of every non-resident spec,
+        submitted smallest-first. Returns how many executables this call
+        minted (0 = everything was already resident)."""
+        pending = [s for s in specs if not self.resident(s)]
+        pending.sort(key=lambda s: s[1])
+        futures = [f for f in (self._submit(s) for s in pending) if f is not None]
+        minted = 0
+        for f in futures:
+            if f.result():
+                minted += 1
+        if minted:
+            log.info(
+                "tier executables compiled",
+                minted=minted,
+                labels=[s[0] for s in pending],
+            )
+        return minted
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def stats(self) -> dict[str, float]:
+        """label -> cumulative compile seconds (sorted for stable JSON)."""
+        with self._lock:
+            return {k: round(v, 3) for k, v in sorted(self.tier_s.items())}
+
+
+# Process-wide singleton: every engine shares the pool and the per-label
+# compile-time ledger, mirroring EXEC_CACHE's process-wide sharing.
+TIER_COMPILER = TierCompiler()

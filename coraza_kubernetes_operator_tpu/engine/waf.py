@@ -35,19 +35,35 @@ def _bucket(n: int, lo: int = 1) -> int:
 
 
 def _bucket_rows(n: int) -> int:
-    """Row-count bucket: power of two up to 2048, then multiples of 1024.
-    Pure doubling wasted up to ~2x on the target axis (a 4096-request
-    serving batch yields ~8.4k target rows → a 16384 bucket, so ~half of
-    every matcher pass ran on padding); 1024-granularity caps the waste
-    at ~12% for a bounded set of extra trace shapes."""
+    """Row-count bucket: power of two up to 2048, then two sizes per
+    octave ({3·2^(k-1), 2^k}: 3072, 4096, 6144, 8192, …).
+
+    QUANTIZED lattice (shape quantization): the old 1024-granularity
+    above 2048 capped padding waste at ~12% but minted a fresh shape
+    signature — and a fresh cold executable — every 1024 rows, which is
+    exactly the signature explosion that blew bench config 3's budget.
+    Two sizes per octave bounds padding waste at ~33% while the distinct
+    shape count grows logarithmically, so similar-size batches collapse
+    onto the same executables (EXEC_CACHE hits instead of compiles)."""
     if n <= 2048:
         return _bucket(n)
-    return (n + 1023) // 1024 * 1024
+    size = 2048
+    while True:
+        if n <= size * 3 // 2:
+            return size * 3 // 2
+        size *= 2
+        if n <= size:
+            return size
 # Row-level length-tier bounds (buffer widths). A row lands in the
 # smallest tier its bytes (and host-variant bytes) fit; tiers with fewer
 # than _MIN_TIER_ROWS rows are merged into the next wider tier so a few
-# stragglers don't buy extra trace shapes.
-_TIER_BOUNDS = (32, 64, 128, 512, 2048, 8192, 32768)
+# stragglers don't buy extra trace shapes. COARSE lattice (shape
+# quantization): ~one bound per two octaves — each bound is a separate
+# matcher executable to compile cold, and the matcher cost is linear in
+# width, so halving the bound count halves the cold executables at a
+# bounded (≤4x-width worst case, same as the old lattice's widest gaps)
+# per-row padding cost.
+_TIER_BOUNDS = (64, 256, 1024, 4096, 16384)
 _MIN_TIER_ROWS = 256
 
 # Kind-partitioned matching: rows within a length tier are further split
@@ -236,6 +252,8 @@ def tier_tensors(tensors, kind_lut=None, cache=None):
         cached.append(cpk)
         miss_keys.append(mkeys)
 
+    # Forward merge: absorb sub-minimum tiers into the next wider bound.
+    merged: list[tuple[int, np.ndarray]] = []
     i = 0
     while i < len(raw):
         b, sel = raw[i]
@@ -243,11 +261,24 @@ def tier_tensors(tensors, kind_lut=None, cache=None):
             i += 1
             b = raw[i][0]
             sel = np.concatenate([sel, raw[i][1]])
+        merged.append((b, sel))
+        i += 1
+    # Post-quantization re-check: the forward merge only runs while a
+    # NEXT tier exists, so the trailing tier can still land under the
+    # minimum (the coarsened bound lattice makes this common — fewer
+    # bounds means the tail bucket often holds just a few long rows).
+    # Merge it backward into the previous tier (at the wider width) so a
+    # handful of stragglers never mint a tiny odd-shaped executable.
+    if len(merged) > 1 and merged[-1][1].size < _MIN_TIER_ROWS:
+        b_last, sel_last = merged.pop()
+        b_prev, sel_prev = merged[-1]
+        merged[-1] = (max(b_prev, b_last), np.concatenate([sel_prev, sel_last]))
+
+    for b, sel in merged:
         length = _bucket(max(_MIN_LEN, b))
 
         if kind_lut is None or _TIER_PARTS <= 1:
             emit(sel, length, None)
-            i += 1
             continue
         # kind_lut maps kinds to CLASS masks (a small fixed per-engine
         # set), so pmask takes at most ~2^parts distinct values and the
@@ -272,7 +303,6 @@ def tier_tensors(tensors, kind_lut=None, cache=None):
         else:
             for s, um in parts:
                 emit(s, length, int(um))
-        i += 1
     if cache is None:
         return tuple(tiers), numvals, tuple(masks)
     return tuple(tiers), numvals, tuple(masks), tuple(cached), miss_keys
@@ -332,6 +362,12 @@ class InFlightBatch:
     rejected: dict[int, Verdict]
     miss_keys: list | None
     cache_pop: bool  # out carries tier hit rows for value-cache population
+    # True when EVERY stage of this window ran on device. Lazy tier
+    # compilation (CKO_LAZY_TIERS=1) routes not-yet-compiled tiers
+    # through the host fallback — such mixed windows must not flip the
+    # engine's ``warmed`` flag (the promotion/timeout machinery reads it
+    # as "device executables resident and proven").
+    device: bool = True
     # Stage timings (observability + bench): host_s is filled by prepare
     # (extract + tensorize + tier + dispatch enqueue); device_s/decode_s
     # by collect (readback block / verdict decode).
@@ -448,6 +484,31 @@ class WafEngine:
             ValueHitCache((max(1, g_total) + 7) // 8, cache_mb * 2**20)
             if cache_mb > 0
             else None
+        )
+        # Split per-tier dispatch (cold-compile collapse): each tier's
+        # matcher and the post stage compile as independent executables
+        # (engine/tier_compile.py). CKO_LAZY_TIERS=1 routes tiers whose
+        # executable is not yet resident through the host fallback while
+        # a thread pool compiles them smallest-first (the sidecar entry
+        # defaults it on); the default eager mode blocks the first
+        # dispatch until every executable landed — still parallel and
+        # smallest-first, but deterministic for tests and bench.
+        self._lazy = _os.environ.get("CKO_LAZY_TIERS", "0") == "1"
+        # Distinct executable shape signatures this engine has dispatched
+        # (cko_exec_signatures / CompileReport.exec_signatures).
+        self._exec_signatures: set = set()
+        # Host-tier-path helpers: _dev_col_of[orig_gid] = device hit
+        # column (inverse of model.group_order), and per matcher block
+        # (segs then banks — match_tier's column order) its group count,
+        # for mask-off zeroing in _host_tier_hits.
+        order = self.model.group_order
+        col_of = np.zeros(max(1, len(order)), dtype=np.int64)
+        for col, gid in enumerate(order):
+            col_of[gid] = col
+        self._dev_col_of = col_of
+        self._block_group_counts = tuple(
+            [s.n_groups for s in self.model.segs]
+            + [b.n_groups for b in self.model.banks]
         )
         # Host fallback evaluator (degraded-mode serving): built lazily on
         # first use — pure NumPy over the same compiled tables, so it can
@@ -662,7 +723,8 @@ class WafEngine:
                         self.value_cache.insert(keys, hp[: len(keys)])
         else:
             packed = jax.device_get(inflight.out)
-        self.warmed = True
+        if inflight.device:
+            self.warmed = True
         t1 = time.perf_counter()
         inflight.device_s = t1 - t0
         verdicts = self._decode_packed(packed, inflight.n_live)
@@ -693,6 +755,54 @@ class WafEngine:
             tensors, self._kind_block_lut, cache=self.value_cache
         )
 
+    def _tier_specs(
+        self, tiers, numvals, max_phase: int = 2, masks=None, cached=None
+    ):
+        """Build the per-tier compile specs for one batch: one matcher
+        spec per tier (``match_tier_packed``) plus one post-stage spec
+        (``eval_post_tiered``). Returns ``(match_specs, post_spec,
+        pairs)`` where pairs is the per-tier ``(kind1, kind2, kind3,
+        req_id, uid)`` tuple the post stage consumes.
+
+        The post spec's hit arrays are zero-filled PLACEHOLDERS: only
+        shapes/dtypes enter the executable-cache key and the lowered
+        program, so warming with zeros mints exactly the executable the
+        real dispatch calls with live matcher output."""
+        from ..models.waf_model import eval_post_tiered, match_tier_packed
+
+        if masks is None:
+            masks = (None,) * len(tiers)
+        g = int(self.model.e_lg.shape[0])
+        pb = (g + 7) // 8
+        match_specs = []
+        pairs = []
+        for t, mask in zip(tiers, masks):
+            u, length = t[0].shape
+            match_specs.append(
+                (
+                    f"match:{u}x{length}",
+                    float(u) * float(length),
+                    match_tier_packed,
+                    (self.model, t[0], t[1], t[6], t[7]),
+                    {"mask": mask},
+                    {},
+                )
+            )
+            pairs.append((t[2], t[3], t[4], t[5], t[8]))
+        pairs = tuple(pairs)
+        ph_hits = tuple(
+            np.zeros((t[0].shape[0], pb), dtype=np.uint8) for t in tiers
+        )
+        post_spec = (
+            "post",
+            0.0,  # sorts first: every verdict needs the post stage
+            eval_post_tiered,
+            (self.model, ph_hits, pairs, numvals),
+            {"max_phase": max_phase},
+            {"cached": cached},
+        )
+        return match_specs, post_spec, pairs
+
     def _dispatch_tiers(
         self,
         tiers,
@@ -703,13 +813,27 @@ class WafEngine:
         cached=None,
         miss_keys=None,
     ) -> InFlightBatch:
-        """Enqueue one tiered batch on device (no host sync) and return
-        the in-flight handle. The single dispatch site shared by the
-        synchronous path (``_verdicts_from_tiers``) and the pipelined
-        path (``prepare``) — the two can never drift."""
-        from ..models.waf_model import eval_waf_compact_tiered
+        """Enqueue one tiered batch (no host sync on the device path)
+        and return the in-flight handle. The single dispatch site shared
+        by the synchronous path (``_verdicts_from_tiers``) and the
+        pipelined path (``prepare``) — the two can never drift.
+
+        Split per-tier dispatch (cold-compile collapse): each tier's
+        matcher and the post stage are independent executables compiled
+        smallest-first across a thread pool (engine/tier_compile.py).
+        Eager mode (default) blocks until every executable for this
+        batch's shapes is resident, then dispatches — parallel compile,
+        deterministic behavior. Lazy mode (CKO_LAZY_TIERS=1) dispatches
+        resident stages on device and routes the rest through the host
+        fallback twins (``_host_tier_hits`` / ``_host_post``) while
+        their compiles land — per-tier degraded-mode promotion. Both
+        paths are bit-identical: packbits over the group-hit columns is
+        lossless and the host twins are differential-tested against the
+        device stages."""
+        from ..models.waf_model import eval_post_tiered
         from ..testing.faults import on_device_dispatch
         from .compile_cache import EXEC_CACHE
+        from .tier_compile import TIER_COMPILER, spec_key
 
         # Fault-injection hook (no-op when the CKO_FAULT_* knobs are
         # unset): stalls cold engines like a real first XLA compile and
@@ -717,30 +841,165 @@ class WafEngine:
         # tests/test_degraded_mode.py uses to prove the fallback +
         # breaker invariants.
         on_device_dispatch(warmed=self.warmed)
-        # One small transfer at collect time: device->host readback
-        # dominates serving once the host path is native (matched is
-        # bit-packed on device and the verdict tensors ride a single
-        # packed array).
-        #
-        # Dispatch rides the process-wide executable cache: the compiled
-        # program is a function of the SHAPE SIGNATURE only (tier shapes,
-        # mask tuple, model layout — engine/compile_cache.py), with every
-        # DFA/segment table a runtime operand. Tenants sharing a layout,
-        # hot reloads with an unchanged signature, and repeat bench
-        # configs all reuse one executable instead of recompiling.
-        out = EXEC_CACHE.call(
-            eval_waf_compact_tiered,
-            (self.model, tiers, numvals),
-            {"max_phase": max_phase, "masks": masks},
-            {"cached": cached},
+        if masks is None:
+            masks = (None,) * len(tiers)
+        match_specs, post_spec, pairs = self._tier_specs(
+            tiers, numvals, max_phase=max_phase, masks=masks, cached=cached
         )
+        specs = match_specs + [post_spec]
+        for s in specs:
+            self._exec_signatures.add(spec_key(s))
+        self.compiled.report.exec_signatures = len(self._exec_signatures)
+        if self._lazy:
+            # Non-blocking: enqueue every missing executable NOW, in
+            # ascending cost order, so the pool mints the smallest tier
+            # (and the post stage) first — first-verdict-from-device
+            # latency is gated on the smallest group's compile.
+            for s in sorted(specs, key=lambda s: s[1]):
+                TIER_COMPILER.ensure(s)
+        else:
+            TIER_COMPILER.compile_all(specs)
+        device = True
+        tier_hits = []
+        for spec, tier, mask in zip(match_specs, tiers, masks):
+            if not self._lazy or TIER_COMPILER.resident(spec):
+                _label, _cost, fn, fargs, statics, dyn = spec
+                tier_hits.append(EXEC_CACHE.call(fn, fargs, statics, dyn))
+            else:
+                device = False
+                tier_hits.append(self._host_tier_hits(tier, mask))
+        tier_hits = tuple(tier_hits)
+        # The post stage takes packed hit rows from EITHER provenance —
+        # device matcher output or host-computed numpy — at identical
+        # shapes/bit layout, so a mixed window still shares the one post
+        # executable.
+        if not self._lazy or TIER_COMPILER.resident(post_spec):
+            packed = EXEC_CACHE.call(
+                eval_post_tiered,
+                (self.model, tier_hits, pairs, numvals),
+                {"max_phase": max_phase},
+                {"cached": cached},
+            )
+        else:
+            device = False
+            packed = self._host_post(tier_hits, pairs, numvals, max_phase, cached)
         return InFlightBatch(
-            out=out,
+            out=(packed, tier_hits) if cached is not None else packed,
             n_live=n_requests,
             n_requests=n_requests,
             rejected={},
             miss_keys=miss_keys,
             cache_pop=cached is not None,
+            device=device,
+        )
+
+    # -- host twins for not-yet-compiled stages (lazy tier compilation) ------
+
+    def _host_tier_hits(self, tier, mask) -> np.ndarray:
+        """Host twin of ``match_tier_packed`` for one tier: walk the
+        host fallback's scalar DFAs over the tier's unique rows and
+        return the bit-packed hit matrix [U, PB] uint8 in DEVICE column
+        order — byte-identical to the device matcher's output, so the
+        value cache and the device post stage consume it unchanged."""
+        d = np.asarray(tier[0])
+        lg = np.asarray(tier[1])
+        vd = np.asarray(tier[6])
+        vl = np.asarray(tier[7])
+        u = d.shape[0]
+        g = int(self.model.e_lg.shape[0])
+        hits = np.zeros((u, g), dtype=bool)
+        hf = self.host_fallback
+        for pid, gids, matcher in hf._pipe_groups:
+            slot = int(self.model.host_variant_index[pid])
+            if slot >= 0:
+                # Host-pipeline variant rows were transformed (and
+                # re-capped) at tensorize time — reuse them verbatim.
+                vals = [
+                    vd[slot, i, : vl[slot, i]].tobytes() for i in range(u)
+                ]
+            else:
+                names = list(self.compiled.pipelines[pid])
+                vals = [
+                    apply_pipeline(d[i, : lg[i]].tobytes(), names)
+                    for i in range(u)
+                ]
+            ph = matcher.search_values(vals)  # [U, len(gids)]
+            hits[:, self._dev_col_of[np.asarray(gids)]] = ph
+        if mask is not None:
+            # Kind-partition parity: the device matcher emits all-False
+            # for mask-off blocks (bits 0-61; >= 62 always scanned) —
+            # zero the same column spans so packed rows stay identical.
+            start = 0
+            for bi, n_g in enumerate(self._block_group_counts):
+                if bi < 62 and not (mask >> bi) & 1:
+                    hits[:, start : start + n_g] = False
+                start += n_g
+        return np.packbits(hits, axis=1)
+
+    def _host_post(self, tier_hits, pairs, numvals, max_phase, cached) -> np.ndarray:
+        """Host twin of ``eval_post_tiered``: unpack each tier's packed
+        hit rows (device or host provenance), append cached rows, expand
+        to pair rows by uid, drop the padding pair rows (their req_id is
+        the out-of-range pad bucket — the host reducer scatters by index
+        and must not touch it), permute columns back to ORIGINAL group
+        order for the fallback's link tables, and run its NumPy
+        post-match. The packed verdict layout matches ``_pack_verdicts``
+        bit for bit."""
+        g = int(self.model.e_lg.shape[0])
+        rows, k1s, k2s, k3s, rids = [], [], [], [], []
+        for ti, (hp, (k1, k2, k3, rid, uid)) in enumerate(zip(tier_hits, pairs)):
+            hu = np.unpackbits(
+                np.asarray(jax.device_get(hp)), axis=1, count=g
+            ).astype(bool)
+            if cached is not None and cached[ti] is not None:
+                cu = np.unpackbits(
+                    np.asarray(cached[ti]), axis=1, count=g
+                ).astype(bool)
+                hu = np.concatenate([hu, cu], axis=0)
+            rows.append(hu[np.asarray(uid)])
+            k1s.append(np.asarray(k1))
+            k2s.append(np.asarray(k2))
+            k3s.append(np.asarray(k3))
+            rids.append(np.asarray(rid))
+        hits = np.concatenate(rows, axis=0)
+        k1 = np.concatenate(k1s)
+        k2 = np.concatenate(k2s)
+        k3 = np.concatenate(k3s)
+        rid = np.concatenate(rids)
+        real = rid < numvals.shape[0]
+        out = self.host_fallback._post_match(
+            hits[real][:, self._dev_col_of],
+            k1[real],
+            k2[real],
+            k3[real],
+            rid[real],
+            np.asarray(numvals),
+            max_phase,
+        )
+        return self._pack_verdicts_np(out)
+
+    @staticmethod
+    def _pack_verdicts_np(out) -> np.ndarray:
+        """NumPy mirror of ``models/waf_model._pack_verdicts`` — same
+        [B, 3 + nw + C] int32 layout (``unpack_compact`` reads both via
+        a raw byte view, so host- and device-packed arrays decode
+        identically)."""
+        b = out["status"].shape[0]
+        head = np.stack(
+            [
+                out["interrupted"].astype(np.int32),
+                out["status"].astype(np.int32),
+                out["rule_index"].astype(np.int32),
+            ],
+            axis=1,
+        )
+        bits = np.packbits(out["matched"].astype(np.uint8), axis=1)
+        pad = (-bits.shape[1]) % 4
+        if pad:
+            bits = np.pad(bits, ((0, 0), (0, pad)))
+        words = np.ascontiguousarray(bits).view(np.int32)
+        return np.concatenate(
+            [head, words, out["scores"].astype(np.int32)], axis=1
         )
 
     def _verdicts_from_tiers(
@@ -802,18 +1061,17 @@ class WafEngine:
     # -- AOT pre-warm --------------------------------------------------------
 
     def batch_signature(self, requests: list[HttpRequest], max_phase: int = 2):
-        """The shape signature the given batch would dispatch under —
-        the executable-cache key (engine/compile_cache.py). Two engines
-        whose signatures match share one compiled executable."""
-        from ..models.waf_model import eval_waf_compact_tiered
-        from .compile_cache import EXEC_CACHE
+        """The shape signatures the given batch would dispatch under —
+        the tuple of per-stage executable-cache keys (one per tier
+        matcher plus the post stage; engine/compile_cache.py). Two
+        engines whose signatures match share every compiled executable."""
+        from .tier_compile import spec_key
 
         tiers, numvals, masks, cached, _mkeys = self._batch_tensors(requests)
-        return EXEC_CACHE.key_for(
-            eval_waf_compact_tiered,
-            (self.model, tiers, numvals, cached),
-            {"max_phase": max_phase, "masks": masks},
+        match_specs, post_spec, _pairs = self._tier_specs(
+            tiers, numvals, max_phase=max_phase, masks=masks, cached=cached
         )
+        return tuple(spec_key(s) for s in match_specs + [post_spec])
 
     def _batch_tensors(self, requests: list[HttpRequest]):
         if self._native.available:
@@ -842,8 +1100,7 @@ class WafEngine:
         the persistent disk cache makes repeat processes cheap).
         Returns ``{"compiled": bool, "wall_s": float}``."""
 
-        from ..models.waf_model import eval_waf_compact_tiered
-        from .compile_cache import EXEC_CACHE
+        from .tier_compile import TIER_COMPILER
 
         if requests is None:
             requests = [warmup_request()]
@@ -857,12 +1114,13 @@ class WafEngine:
             batches.append(synthetic_requests(warm_n, attack_ratio=0.1, seed=7))
         for batch in batches:
             tiers, numvals, masks, cached, _mkeys = self._batch_tensors(batch)
-            compiled = EXEC_CACHE.warm(
-                eval_waf_compact_tiered,
-                (self.model, tiers, numvals),
-                {"max_phase": 2, "masks": masks},
-                {"cached": cached},
-            ) or compiled
+            match_specs, post_spec, _pairs = self._tier_specs(
+                tiers, numvals, max_phase=2, masks=masks, cached=cached
+            )
+            compiled = (
+                TIER_COMPILER.compile_all(match_specs + [post_spec]) > 0
+                or compiled
+            )
         return {"compiled": compiled, "wall_s": time.perf_counter() - t0}
 
     # -- phase-split serving -------------------------------------------------

@@ -76,7 +76,14 @@ def _state_bucket(n_states: int) -> int:
 
 # Size buckets for DFA banks (n_states ceiling): groups whose tables fit the
 # same bucket share one padded bank — bounded padding waste, few fused scans.
-_STATE_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
+# COARSE lattice (shape quantization): buckets GROUP banks — stack_dfas
+# still pads each bank to its largest member, so coarsening trades some
+# small-member padding inside a bank for far fewer distinct bank
+# layouts: fewer executables to compile cold, more EXEC_CACHE sharing
+# across similar-size rulesets. Hopcroft minimization
+# (compiler/re_dfa.py) already shrank the state counts feeding this
+# lattice, so the octave-per-step resolution loss is cheap.
+_STATE_BUCKETS = (32, 256, 2048, 16384, 65536)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -160,6 +167,12 @@ class WafModel:
     two_pass_counters: bool = False
     # Static: block indexes whose hit columns come from flat_banks.
     flat_covered: tuple = ()
+    # Host-side only: ORIGINAL group id held by each device hit column
+    # (the inverse of build_model's remap). The lazy per-tier dispatch
+    # uses it to compute host-path tier hits in device column order and
+    # to permute them back for the host post-match. Canonicalized out of
+    # the aux like block_kinds/block_cost — never read in a trace.
+    group_order: tuple = ()
 
     def tree_flatten(self):
         leaves = (
@@ -216,6 +229,7 @@ class WafModel:
             (),  # block_cost: host-side only, canonicalized out
             self.two_pass_counters,
             self.flat_covered,
+            (),  # group_order: host-side only, canonicalized out
         )
         return leaves, aux
 
@@ -473,6 +487,13 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
             block_cost.append(0.5 * s * max(g, 128))  # VMEM-resident MXU scan
         else:
             block_cost.append(8.0 * s * g)  # HBM take-scan
+    # Inverse of remap: original group id per device hit column (host
+    # metadata for the lazy host-tier path — see WafModel.group_order).
+    n_g = len(crs.groups)
+    order_arr = np.zeros(n_g, dtype=np.int64)
+    order_arr[remap[:n_g]] = np.arange(n_g, dtype=np.int64)
+    group_order = tuple(int(x) for x in order_arr)
+
     w_np = np.asarray(weights)
     two_pass_counters = any(
         any(crs.links[l].link_type == LINK_COUNTER for l in r.link_ids)
@@ -525,6 +546,7 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         block_cost=tuple(block_cost),
         two_pass_counters=two_pass_counters,
         flat_covered=tuple(sorted(flat_covered)),
+        group_order=group_order,
     )
 
 
@@ -1099,6 +1121,77 @@ def eval_waf_compact_tiered(
         jnp.packbits(h.astype(jnp.uint8), axis=1) for h in out["_tier_hits"]
     )
     return packed, hits_packed
+
+
+# -- split per-tier dispatch (cold-compile collapse) --------------------------
+#
+# The monolithic eval_waf_compact_tiered trace compiles every tier's
+# matcher plus the post stage as ONE executable: any tier-shape change
+# recompiles everything, and a cold start pays the whole program before
+# the first verdict. The split entries below compile independently —
+# same-shape tiers across batches/tenants share one matcher executable,
+# a thread pool compiles them in parallel (XLA releases the GIL), and a
+# not-yet-compiled tier can route through the host fallback while its
+# executable lands (engine/tier_compile.py + WafEngine._dispatch_tiers).
+# Verdict parity with the monolith is exact: packbits/unpackbits over G
+# group-hit bits is lossless, and post_match is byte-for-byte the same
+# stage the monolith runs.
+
+
+@partial(jax.jit, static_argnames=("mask",))
+def match_tier_packed(
+    model: WafModel,
+    data: jnp.ndarray,  # [U, L] uint8 unique-value rows
+    lengths: jnp.ndarray,  # [U]
+    variant_data: jnp.ndarray,  # [H, U, L]
+    variant_lengths: jnp.ndarray,  # [H, U]
+    mask: int | None = None,
+) -> jnp.ndarray:
+    """One tier's matcher stage as its own executable: transforms +
+    matchers over the tier's unique rows, bit-packed to [U, PB] uint8
+    (np.packbits layout — the same format the value cache stores and
+    ``eval_post_tiered`` / the host post path unpack)."""
+    hits_u = match_tier(model, data, lengths, variant_data, variant_lengths, mask=mask)
+    return jnp.packbits(hits_u.astype(jnp.uint8), axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_phase",))
+def eval_post_tiered(
+    model: WafModel,
+    tier_hits,  # tuple of [U, PB] uint8 per tier (packed matcher rows)
+    pairs,  # tuple of (kind1, kind2, kind3, req_id, uid) per tier
+    numvals: jnp.ndarray,
+    max_phase: int = 2,
+    cached=None,  # aligned tuple of [Uc, PB] uint8 or None per tier
+) -> jnp.ndarray:
+    """The post stage as its own executable: unpack each tier's packed
+    hit rows (matcher output or host-computed — same shapes, same bit
+    layout, so provenance never changes the trace), append the tier's
+    cached rows, expand to pair rows via uid, and run ONE global
+    post_match + verdict pack. Identical math to the tail of
+    ``eval_waf_compact_tiered``."""
+    g = model.e_lg.shape[0]
+    hits, k1s, k2s, k3s, rids = [], [], [], [], []
+    for ti, (hp, (k1, k2, k3, rid, uid)) in enumerate(zip(tier_hits, pairs)):
+        hu = _unpack_hit_rows(hp, g)
+        if cached is not None and cached[ti] is not None:
+            hu = jnp.concatenate([hu, _unpack_hit_rows(cached[ti], g)], axis=0)
+        hits.append(jnp.take(hu, uid, axis=0))  # [P, G] pair rows
+        k1s.append(k1)
+        k2s.append(k2)
+        k3s.append(k3)
+        rids.append(rid)
+    out = post_match(
+        model,
+        jnp.concatenate(hits, axis=0),
+        jnp.concatenate(k1s),
+        jnp.concatenate(k2s),
+        jnp.concatenate(k3s),
+        jnp.concatenate(rids),
+        numvals,
+        max_phase,
+    )
+    return _pack_verdicts(out)
 
 
 def unpack_compact(packed: np.ndarray, n_rules: int, n_counters: int):

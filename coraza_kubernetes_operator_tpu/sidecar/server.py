@@ -71,6 +71,12 @@ def _exec_cache_stats() -> dict:
     return EXEC_CACHE.stats()
 
 
+def _tier_compile_stats() -> dict:
+    from ..engine.tier_compile import TIER_COMPILER
+
+    return TIER_COMPILER.stats()
+
+
 API_PREFIX = "/waf/v1/"
 FAILURE_POLICY_FAIL = "fail"
 FAILURE_POLICY_ALLOW = "allow"
@@ -274,7 +280,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(
                 200,
-                self.sidecar.metrics.render().encode(),
+                self.sidecar.render_metrics().encode(),
                 {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
             )
         elif path.startswith(API_PREFIX):
@@ -775,6 +781,27 @@ class TpuEngineSidecar:
             "XLA compiles currently running (includes abandoned"
             " budget-blown rollout candidates)",
         ).set_function(lambda: float(EXEC_CACHE.inflight))
+        # -- cold-compile collapse (docs/COMPILE_CACHE.md) ------------------
+        self.metrics.gauge(
+            "cko_exec_signatures",
+            "Distinct executable shape signatures dispatched"
+            " (default tenant)",
+        ).set_function(lambda: float(self._report_int("exec_signatures")))
+        self.metrics.gauge(
+            "cko_dfa_states_pre_min_total",
+            "Total DFA states before Hopcroft minimization (default tenant)",
+        ).set_function(lambda: float(self._report_int("dfa_states_pre_min")))
+        self.metrics.gauge(
+            "cko_dfa_states_post_min_total",
+            "Total DFA states after Hopcroft minimization (default tenant)",
+        ).set_function(lambda: float(self._report_int("dfa_states_post_min")))
+        # Per-label values are refreshed from TIER_COMPILER at render
+        # time (render_metrics) — labels only exist once a compile ran.
+        self._m_tier_s = self.metrics.gauge(
+            "cko_compile_tier_s",
+            "Cumulative XLA compile seconds per tier executable label",
+            ("tier",),
+        )
         self.batcher.on_engine_error = (
             lambda _engine, err: self.degraded.record_device_failure(err)
         )
@@ -1178,6 +1205,20 @@ class TpuEngineSidecar:
             return 0
         return len(getattr(engine.compiled.report, field))
 
+    def _report_int(self, field: str) -> int:
+        engine = self.tenants.engine_for(None)
+        if engine is None:
+            return 0
+        return int(getattr(engine.compiled.report, field, 0))
+
+    def render_metrics(self) -> str:
+        """Render /metrics, refreshing the per-tier compile-time gauge
+        first (its label set grows as tier executables mint — labels
+        cannot be registered up front)."""
+        for label, secs in _tier_compile_stats().items():
+            self._m_tier_s.set(secs, tier=label)
+        return self.metrics.render()
+
     def stats(self) -> dict:
         return {
             "batcher": self.batcher.stats.snapshot(),
@@ -1194,7 +1235,13 @@ class TpuEngineSidecar:
             "degraded": self.degraded.stats(),
             "shed_total": int(self._m_shed.value()),
             "failopen_total": int(self._m_failopen.value()),
-            "compile_cache": _exec_cache_stats(),
+            "compile_cache": {
+                **_exec_cache_stats(),
+                "exec_signatures": self._report_int("exec_signatures"),
+                "dfa_states_pre_min": self._report_int("dfa_states_pre_min"),
+                "dfa_states_post_min": self._report_int("dfa_states_post_min"),
+                "tier_compile_s": _tier_compile_stats(),
+            },
             "resident_engines": self.tenants.resident_engines(),
             "engine_dedup_hits": self.tenants.engine_dedup_hits,
             "analysis": {

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Cold-compile smoke for the crs-lite ruleset (cold-compile collapse).
+
+One FRESH child process cold-compiles the bundled crs-lite ruleset on
+CPU — no DFA memo, no persistent XLA cache — builds the engine, and
+serves one small batch. The parent asserts the regression ceilings:
+
+- total wall (seclang -> DFA minimize -> model build -> first batch)
+  stays under ``CKO_COMPILE_SMOKE_CEILING_S`` (default 600);
+- minimization bites: ``dfa_states_post_min < dfa_states_pre_min`` and
+  the minimized total stays under ``CKO_SMOKE_STATE_CEILING``;
+- the split dispatch stays split-but-small: distinct executable
+  signatures for the batch under ``CKO_SMOKE_SIG_CEILING``.
+
+Usage: compile_time_smoke.py ; exit 0 on pass, 1 with a JSON line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Ceilings sized from a measured cold run (wall ~167s, minimized states
+# 38778 from 52713 pre-min, 2 signatures) with headroom for slower CI
+# runners — regression alarms, not SLOs.
+DEFAULT_WALL_CEILING_S = 600.0
+DEFAULT_STATE_CEILING = 45000
+DEFAULT_SIG_CEILING = 8
+
+
+def _child() -> None:
+    sys.path.insert(0, str(REPO))
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+    from coraza_kubernetes_operator_tpu.engine.request import HttpRequest
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+
+    t0 = time.perf_counter()
+    compiled = compile_rules(load_ruleset_text())
+    ruleset_s = time.perf_counter() - t0
+    eng = WafEngine(compiled)
+    verdicts = eng.evaluate(
+        [
+            HttpRequest(uri="/?q=%3Cscript%3Ealert(1)%3C/script%3E"),
+            HttpRequest(uri="/?id=1%27%20OR%20%271%27=%271"),
+            HttpRequest(uri="/healthz"),
+        ]
+    )
+    print(
+        json.dumps(
+            {
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "ruleset_s": round(ruleset_s, 2),
+                "dfa_states_pre_min": compiled.report.dfa_states_pre_min,
+                "dfa_states_post_min": compiled.report.dfa_states_post_min,
+                "exec_signatures": compiled.report.exec_signatures,
+                "blocked": sum(1 for v in verdicts if v.interrupted),
+            }
+        )
+    )
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        _child()
+        return 0
+    wall_ceiling = float(
+        os.environ.get("CKO_COMPILE_SMOKE_CEILING_S", DEFAULT_WALL_CEILING_S)
+    )
+    state_ceiling = int(
+        os.environ.get("CKO_SMOKE_STATE_CEILING", DEFAULT_STATE_CEILING)
+    )
+    sig_ceiling = int(os.environ.get("CKO_SMOKE_SIG_CEILING", DEFAULT_SIG_CEILING))
+    env = dict(os.environ)
+    # Cold means cold: no persistent XLA cache for the child.
+    env.pop("CKO_COMPILE_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, __file__, "--child"],
+        capture_output=True,
+        text=True,
+        timeout=wall_ceiling + 120,
+        cwd=str(REPO),
+        env=env,
+    )
+    if proc.returncode != 0:
+        print(json.dumps({"smoke": "FAIL", "stderr": proc.stderr[-2000:]}))
+        return 1
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    res = json.loads(line)
+    ok = (
+        res["wall_s"] <= wall_ceiling
+        and res["dfa_states_post_min"] < res["dfa_states_pre_min"]
+        and res["dfa_states_post_min"] <= state_ceiling
+        and 2 <= res["exec_signatures"] <= sig_ceiling
+        and res["blocked"] >= 2  # the attack payloads still block
+    )
+    verdict = {
+        **res,
+        "wall_ceiling_s": wall_ceiling,
+        "state_ceiling": state_ceiling,
+        "sig_ceiling": sig_ceiling,
+        "smoke": "PASS" if ok else "FAIL",
+    }
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,9 +52,10 @@ def test_distinct_rulesets_share_one_executable():
     verdicts_b = eng_b.evaluate(reqs)
     hits1, misses1, _ = EXEC_CACHE.snapshot()
 
-    # Engine B rode engine A's executable: zero new compiles, one hit.
+    # Engine B rode engine A's executables: zero new compiles, only
+    # hits (one per split-dispatch stage — tier matchers + post).
     assert misses1 == misses0
-    assert hits1 == hits0 + 1
+    assert hits1 > hits0
 
     # ... and still produced ITS OWN verdicts (tables are operands).
     assert [v.interrupted for v in verdicts_a] == [True, False, False]

@@ -1,0 +1,106 @@
+"""Property tests for Hopcroft DFA minimization (cold-compile collapse).
+
+Language-equivalence is the contract: ``compile_nfa_dfa`` minimizes
+every automaton before tables are emitted, so the minimized DFA must
+accept EXACTLY the strings the raw subset-construction DFA accepts —
+on the shared regex corpus, on crs-lite's own ``@rx`` patterns, and on
+fuzzed byte strings. Alongside: ``n_states(min) <= n_states(raw)``,
+``pre_min_states`` bookkeeping, and idempotence.
+"""
+
+from __future__ import annotations
+
+import random
+import re as _stdre
+from pathlib import Path
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler.re_dfa import (
+    DFA,
+    DFAError,
+    compile_nfa_dfa,
+)
+from coraza_kubernetes_operator_tpu.compiler.re_nfa import build_position_nfa
+from coraza_kubernetes_operator_tpu.compiler.re_parser import parse_regex
+
+# Shared regex corpus (patterns + inputs) from the compile tests.
+from test_regex_compile import CORPUS, PATTERNS, _random_inputs
+
+
+def _raw_dfa(pattern: str, case_insensitive: bool = False) -> DFA:
+    """Subset-construction DFA WITHOUT minimization: the oracle the
+    minimized automaton must stay language-equivalent to."""
+    ast = parse_regex(pattern, case_insensitive=case_insensitive)
+    nfa = build_position_nfa(ast)
+    orig = DFA.minimize
+    DFA.minimize = lambda self: self  # type: ignore[method-assign]
+    try:
+        return compile_nfa_dfa(nfa, max_states=65536, ast=ast)
+    finally:
+        DFA.minimize = orig  # type: ignore[method-assign]
+
+
+def _check_equivalent(pattern: str, raw: DFA, mini: DFA, inputs) -> None:
+    assert mini.n_states <= raw.n_states, pattern
+    assert mini.pre_min_states == raw.n_states, pattern
+    for data in inputs:
+        assert mini.search(data) == raw.search(data), (pattern, data)
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_minimized_language_equivalent(pattern):
+    raw = _raw_dfa(pattern)
+    mini = raw.minimize()
+    rng = random.Random(0xC0FFEE ^ len(pattern))
+    _check_equivalent(
+        pattern, raw, mini, list(CORPUS) + _random_inputs(rng, pattern)
+    )
+
+
+@pytest.mark.parametrize("pattern", PATTERNS[:8])
+def test_minimize_idempotent(pattern):
+    mini = _raw_dfa(pattern).minimize()
+    again = mini.minimize()
+    assert again.n_states == mini.n_states
+    assert again.pre_min_states == mini.pre_min_states
+    rng = random.Random(7)
+    for data in list(CORPUS)[:20] + _random_inputs(rng, pattern, n=30):
+        assert again.search(data) == mini.search(data)
+
+
+def _crs_lite_rx_patterns(limit: int = 24) -> list[str]:
+    """Deterministic sample of crs-lite's distinct ``@rx`` patterns —
+    the automata whose state blowup motivated minimization."""
+    root = Path(__file__).resolve().parents[1] / "ftw" / "rules" / "crs-lite"
+    pats: set[str] = set()
+    for conf in sorted(root.glob("*.conf")):
+        for m in _stdre.finditer(r'"@rx\s+(.+?)"\s', conf.read_text()):
+            pats.add(m.group(1))
+    ordered = sorted(pats)
+    # Every 10th pattern: spans all rule families without fuzzing all ~240.
+    return ordered[:: max(1, len(ordered) // limit)][:limit]
+
+
+@pytest.mark.parametrize("pattern", _crs_lite_rx_patterns())
+def test_crs_lite_patterns_minimize_equivalent(pattern):
+    try:
+        raw = _raw_dfa(pattern, case_insensitive=True)
+    except (DFAError, ValueError):
+        pytest.skip("pattern outside the RE2 subset / state budget")
+    mini = raw.minimize()
+    rng = random.Random(len(pattern))
+    inputs = list(CORPUS)[:24] + _random_inputs(rng, pattern, n=60)
+    _check_equivalent(pattern, raw, mini, inputs)
+
+
+def test_compile_nfa_dfa_emits_minimized_tables():
+    """The production entry point minimizes: a context-duplicated
+    pattern comes out smaller than its subset construction, and the
+    pre-minimization count rides along for the CompileReport."""
+    pattern = r"(?i:(\b(select|union)\b.*\b(from|where)\b))"
+    raw = _raw_dfa(pattern)
+    ast = parse_regex(pattern)
+    prod = compile_nfa_dfa(build_position_nfa(ast), ast=ast)
+    assert prod.pre_min_states == raw.n_states
+    assert prod.n_states < raw.n_states  # strictly: this one dedups states
