@@ -55,6 +55,10 @@ bench:  ## Streaming JSON benchmark: one line per config + final summary.
 pipeline.smoke:  ## Host/device overlap gate: pipelined >= 1.2x sync, verdicts identical.
 	$(PYTHON) hack/pipeline_smoke.py
 
+.PHONY: ingest.smoke
+ingest.smoke:  ## Async frontend gate: async >= 2x threaded req/s, verdicts identical.
+	$(PYTHON) hack/ingest_smoke.py
+
 .PHONY: chaos.smoke
 chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage.
 	$(PYTHON) hack/chaos_smoke.py

@@ -77,6 +77,15 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
     p.add_argument("--bind-address", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9090)
     p.add_argument(
+        "--frontend",
+        choices=["async", "threaded"],
+        default="async",
+        help="ingest frontend (docs/SERVING.md): 'async' is the"
+        " asyncio-native single-acceptor loop with keep-alive,"
+        " pipelining, and zero-copy window assembly; 'threaded' is the"
+        " legacy ThreadingHTTPServer escape hatch",
+    )
+    p.add_argument(
         "--audit-log",
         default="",
         help="audit log destination: '-' for stdout (SecAuditLog /dev/stdout"
@@ -175,6 +184,7 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         pipeline_depth=args.pipeline_depth,
         host=args.bind_address,
         port=args.port,
+        frontend=args.frontend,
         request_timeout_s=args.request_timeout_seconds,
         compile_timeout_s=args.compile_timeout_seconds,
         audit_log=args.audit_log or None,

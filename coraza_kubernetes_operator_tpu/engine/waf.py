@@ -368,6 +368,12 @@ class InFlightBatch:
     # engine's ``warmed`` flag (the promotion/timeout machinery reads it
     # as "device executables resident and proven").
     device: bool = True
+    # Index -> Verdict replacements applied AFTER decode (blob windows:
+    # over-limit rows stay in the tensorized batch — post_match reduces
+    # by req_id, so extra rows cannot touch other requests — and their
+    # device verdicts are displaced here by the 413/phase-1 outcome,
+    # matching prepare()'s row-exclusion semantics bit for bit).
+    overrides: dict[int, Verdict] | None = None
     # Stage timings (observability + bench): host_s is filled by prepare
     # (extract + tensorize + tier + dispatch enqueue); device_s/decode_s
     # by collect (readback block / verdict decode).
@@ -703,6 +709,60 @@ class WafEngine:
         inflight.host_s = time.perf_counter() - t0
         return inflight
 
+    def prepare_blob(self, blob: bytes, n_req: int) -> InFlightBatch:
+        """``prepare`` for a pre-assembled request blob (the
+        ``native.serialize_requests`` wire format): the async ingest
+        frontend slices HTTP/1.1 request bytes straight into this
+        layout, so a full window tensorizes in one C++ call with zero
+        per-request Python object materialization. Without the native
+        library the blob materializes into requests and delegates to
+        ``prepare`` — same verdicts, just the Python host path.
+
+        SecRequestBodyLimitAction Reject parity with ``prepare``: the
+        C++ tensorizer truncates over-limit bodies at the limit, and
+        only those few requests materialize for the batched phase-1
+        pre-pass; the resulting 413/phase-1 verdicts land as collect
+        overrides, displacing the over-limit rows' device verdicts."""
+        if not self._native.available:
+            from ..native import blob_requests
+
+            return self.prepare(blob_requests(blob, n_req))
+        t0 = time.perf_counter()
+        prog = self.compiled.program
+        overrides: dict[int, Verdict] = {}
+        if (
+            prog.request_body_access
+            and prog.request_body_limit_action == "Reject"
+        ):
+            from ..native import blob_over_limit, blob_requests
+
+            over = [
+                i
+                for i in blob_over_limit(blob, prog.request_body_limit)
+                if i < n_req
+            ]
+            if over:
+                over_reqs = blob_requests(blob, n_req, wanted=set(over))
+                exs = [
+                    self.extractor.extract(r, phase1_only=True)
+                    for r in over_reqs
+                ]
+                early = self._evaluate_extractions(exs, max_phase=1)
+                for i, v in zip(over, early):
+                    overrides[i] = (
+                        v
+                        if v.interrupted
+                        else Verdict(interrupted=True, status=413, rule_id=None)
+                    )
+        tensors = self._native.tensorize_blob(blob, n_req)
+        tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
+        inflight = self._dispatch_tiers(
+            tiers, numvals, n_req, masks=masks, cached=cached, miss_keys=mkeys
+        )
+        inflight.overrides = overrides or None
+        inflight.host_s = time.perf_counter() - t0
+        return inflight
+
     def collect(self, inflight: InFlightBatch) -> list[Verdict]:
         """Stage 2 of the pipelined hot path: block on the device
         readback of a ``prepare``d window, populate the value cache from
@@ -729,6 +789,10 @@ class WafEngine:
         inflight.device_s = t1 - t0
         verdicts = self._decode_packed(packed, inflight.n_live)
         inflight.decode_s = time.perf_counter() - t1
+        if inflight.overrides:
+            for i, v in inflight.overrides.items():
+                if 0 <= i < len(verdicts):
+                    verdicts[i] = v
         if not inflight.rejected:
             return verdicts
         out: list[Verdict] = []

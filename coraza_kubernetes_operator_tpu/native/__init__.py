@@ -349,12 +349,20 @@ class NativeTensorizer:
         return self._export(res, n_req), n_req, blob
 
     def tensorize(self, requests: list[HttpRequest]):
+        return self.tensorize_blob(serialize_requests(requests), len(requests))
+
+    def tensorize_blob(self, blob: bytes, n_req: int):
+        """Tensorize a pre-assembled request blob (the exact
+        ``serialize_requests`` wire format). The async ingest frontend
+        packs parsed request bytes straight into this layout, so a full
+        ingest window reaches C++ as one contiguous buffer with zero
+        per-request Python object materialization."""
         assert self._ctx is not None
-        blob = serialize_requests(requests)
-        res = self._lib.cko_tensorize(self._ctx, blob, len(blob), len(requests))
+        blob = bytes(blob)
+        res = self._lib.cko_tensorize(self._ctx, blob, len(blob), n_req)
         if not res:
             raise RuntimeError("native tensorize failed (malformed batch blob)")
-        return self._export(res, len(requests))
+        return self._export(res, n_req)
 
     def _export(self, res, n_requests: int):
         try:
@@ -438,6 +446,66 @@ def blob_over_limit(blob: bytes, limit: int) -> list[int]:
         skip()  # remote
         idx += 1
     return res
+
+
+def blob_requests(
+    blob: bytes, n_req: int | None = None, wanted: set[int] | None = None
+) -> list[HttpRequest]:
+    """Materialize ``HttpRequest`` objects from a request blob — the
+    slow-path escape hatch for blob windows (Python tensorizer fallback,
+    degraded-mode host evaluation, shadow mirroring, and the over-limit
+    phase-1 pre-pass). Decoding is latin-1, the exact inverse of
+    ``serialize_requests`` / the ingest frontend's byte slicing, so a
+    materialized request round-trips bit-identically. ``wanted`` limits
+    the result to those indexes (in ascending order)."""
+    out: list[HttpRequest] = []
+    pos = 0
+    idx = 0
+    n = len(blob)
+
+    def rd() -> bytes:
+        nonlocal pos
+        (l,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        val = blob[pos : pos + l]
+        pos += l
+        return val
+
+    while pos < n and (n_req is None or idx < n_req):
+        if wanted is not None and idx not in wanted:
+            # Skip without decoding.
+            for _ in range(3):
+                rd()
+            (nh,) = struct.unpack_from("<I", blob, pos)
+            pos += 4
+            for _ in range(2 * nh + 2):
+                rd()
+            idx += 1
+            continue
+        method = rd().decode("latin-1", "replace")
+        uri = rd().decode("latin-1", "replace")
+        version = rd().decode("latin-1", "replace")
+        (nh,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        headers = []
+        for _ in range(nh):
+            k = rd().decode("latin-1", "replace")
+            v = rd().decode("latin-1", "replace")
+            headers.append((k, v))
+        body = bytes(rd())
+        remote = rd().decode("latin-1", "replace")
+        out.append(
+            HttpRequest(
+                method=method,
+                uri=uri,
+                version=version,
+                headers=headers,
+                body=body,
+                remote_addr=remote,
+            )
+        )
+        idx += 1
+    return out
 
 
 def blob_request_lines(blob: bytes, wanted: set[int]) -> dict[int, tuple]:

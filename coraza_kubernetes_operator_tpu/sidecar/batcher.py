@@ -129,8 +129,23 @@ class _Group:
 
 
 @dataclass
+class _BlobWindow:
+    """A pre-assembled ingest window (sidecar/ingest.py): request bytes
+    already packed in the ``native.serialize_requests`` wire format.
+    Rides the same submit queue, depth semaphore, FIFO in-flight queue,
+    breaker hooks, and stats as per-request windows — but dispatches as
+    ONE ``engine.prepare_blob`` call, so the hot path never materializes
+    per-request Python objects. The future resolves to the window's
+    ``list[Verdict]`` (or the group error)."""
+
+    blob: bytes
+    n_req: int
+    fut: Future
+
+
+@dataclass
 class _WindowRecord:
-    window: list
+    window: object  # list of (req, tenant, fut) triples, or a _BlobWindow
     groups: list
 
 
@@ -201,6 +216,16 @@ class MicroBatcher:
         # queue); like the breaker hooks it is a side channel — a raising
         # hook never decides a verdict.
         self.on_window = None  # (engine, requests, verdicts, serving_s) -> None
+        # Blob windows carry no request objects; materializing them just
+        # to feed on_window would tax every hot-path window. When set,
+        # window_wanted(engine) -> bool gates that materialization — the
+        # sidecar wires it to "a rollout is actively shadowing this
+        # engine", which is the only consumer.
+        self.window_wanted = None  # (engine,) -> bool
+        # Requests inside queued-but-not-dispatched blob windows; the
+        # admission-control signal must count them (a blob window is one
+        # queue item but n_req requests of backlog).
+        self._blob_pending = 0
 
     @property
     def busy(self) -> bool:
@@ -269,7 +294,11 @@ class MicroBatcher:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if item is not None:
+            if isinstance(item, _BlobWindow):
+                with self._inflight_lock:
+                    self._blob_pending -= item.n_req
+                _resolve(item.fut.set_exception, err)
+            elif item is not None:
                 _resolve(item[2].set_exception, err)
 
     def submit(self, request: HttpRequest, tenant: str | None = None) -> Future:
@@ -278,9 +307,28 @@ class MicroBatcher:
         self._queue.put((request, tenant, fut))
         return fut
 
+    def submit_window(self, blob: bytes, n_req: int) -> Future:
+        """Enqueue a pre-assembled ingest window (request blob in the
+        ``native.serialize_requests`` format). Dispatched as its own
+        window — never coalesced with per-request submissions — on the
+        default tenant's engine pinned at dispatch time (reload-safe
+        draining, same as per-request windows). The Future resolves to
+        the window's ``list[Verdict]``."""
+        fut: Future = Future()
+        with self._inflight_lock:
+            self._blob_pending += n_req
+        self._queue.put(_BlobWindow(blob=blob, n_req=n_req, fut=fut))
+        return fut
+
     def pending(self) -> int:
-        """Requests queued but not yet picked into a window."""
-        return self._queue.qsize()
+        """Requests queued but not yet picked into a window (blob
+        windows count their full request payload)."""
+        with self._inflight_lock:
+            blob_n = self._blob_pending
+        # qsize() also counts queued _BlobWindow items (1 each); their
+        # requests are already in blob_n, so subtracting nothing keeps
+        # the signal conservative (over-counts by the window count).
+        return self._queue.qsize() + blob_n
 
     def evaluate(
         self, request: HttpRequest, timeout_s: float = 30.0, tenant: str | None = None
@@ -290,16 +338,30 @@ class MicroBatcher:
     # -- dispatch stage ------------------------------------------------------
 
     def _run(self) -> None:
-        while self._running:
-            item = self._queue.get()
+        carry = None
+        while self._running or carry is not None:
+            item = carry if carry is not None else self._queue.get()
+            carry = None
             if item is None:
                 continue
             if not self._running:
-                _resolve(item[2].set_exception, EngineUnavailable("batcher stopped"))
+                err = EngineUnavailable("batcher stopped")
+                if isinstance(item, _BlobWindow):
+                    with self._inflight_lock:
+                        self._blob_pending -= item.n_req
+                    _resolve(item.fut.set_exception, err)
+                else:
+                    _resolve(item[2].set_exception, err)
                 continue
             with self._inflight_lock:
                 self._window_open = True
             try:
+                if isinstance(item, _BlobWindow):
+                    # Pre-assembled window: dispatch as-is, never coalesce.
+                    with self._inflight_lock:
+                        self._blob_pending -= item.n_req
+                    self._dispatch_or_fail(item)
+                    continue
                 window: list[tuple[HttpRequest, str | None, Future]] = [item]
                 deadline = time.monotonic() + self.max_batch_delay_s
                 while len(window) < self.max_batch_size:
@@ -311,6 +373,11 @@ class MicroBatcher:
                     except queue.Empty:
                         break
                     if nxt is None:
+                        break
+                    if isinstance(nxt, _BlobWindow):
+                        # A blob window closes the assembling window; it
+                        # dispatches on the next loop turn (FIFO kept).
+                        carry = nxt
                         break
                     window.append(nxt)
                 self._dispatch_or_fail(window)
@@ -326,13 +393,19 @@ class MicroBatcher:
         while not self._depth_sem.acquire(timeout=0.1):
             if not self._running:
                 err = EngineUnavailable("batcher stopped")
-                for _req, _tenant, fut in window:
-                    _resolve(fut.set_exception, err)
+                if isinstance(window, _BlobWindow):
+                    _resolve(window.fut.set_exception, err)
+                else:
+                    for _req, _tenant, fut in window:
+                        _resolve(fut.set_exception, err)
                 return
         with self._inflight_lock:
             self._inflight_count += 1
         try:
-            record = self._dispatch_window(window)
+            if isinstance(window, _BlobWindow):
+                record = self._dispatch_blob(window)
+            else:
+                record = self._dispatch_window(window)
         except BaseException:
             # _dispatch_window is defensive per group; anything escaping
             # it must still release the slot or the pipeline deadlocks.
@@ -403,6 +476,33 @@ class MicroBatcher:
             out_groups.append(g)
         return _WindowRecord(window=window, groups=out_groups)
 
+    def _dispatch_blob(self, bw: _BlobWindow) -> _WindowRecord:
+        """Dispatch a pre-assembled ingest window: one engine (default
+        tenant, pinned here — a reload lands on the NEXT window), one
+        ``prepare_blob`` call. Engines without the blob API (test stubs)
+        materialize the requests and evaluate synchronously."""
+        engine = self._engine_fn(None)
+        g = _Group(engine=engine, idxs=[], t_dispatch=time.monotonic())
+        if engine is None:
+            g.error = EngineUnavailable(
+                "no compiled ruleset loaded for tenant None"
+            )
+        else:
+            try:
+                if not self.phase_split and hasattr(engine, "prepare_blob"):
+                    g.inflight = engine.prepare_blob(bw.blob, bw.n_req)
+                else:
+                    from ..native import blob_requests
+
+                    reqs = blob_requests(bw.blob, bw.n_req)
+                    if self.phase_split:
+                        g.verdicts = engine.evaluate_phased(reqs)
+                    else:
+                        g.verdicts = engine.evaluate(reqs)
+            except Exception as err:
+                g.error = err
+        return _WindowRecord(window=bw, groups=[g])
+
     # -- collect stage -------------------------------------------------------
 
     def _collect_loop(self) -> None:
@@ -421,15 +521,22 @@ class MicroBatcher:
                 # sidecar still looks alive. Fail this record's
                 # unresolved futures and keep collecting.
                 log.error("window collect failed", err)
-                for _req, _tenant, fut in record.window:
-                    if not fut.done():
-                        _resolve(fut.set_exception, err)
+                if isinstance(record.window, _BlobWindow):
+                    if not record.window.fut.done():
+                        _resolve(record.window.fut.set_exception, err)
+                else:
+                    for _req, _tenant, fut in record.window:
+                        if not fut.done():
+                            _resolve(fut.set_exception, err)
             finally:
                 with self._inflight_lock:
                     self._inflight_count -= 1
                 self._depth_sem.release()
 
     def _collect_record(self, record: _WindowRecord) -> None:
+        if isinstance(record.window, _BlobWindow):
+            self._collect_blob(record)
+            return
         for g in record.groups:
             if g.error is None and g.verdicts is None:
                 try:
@@ -485,6 +592,67 @@ class MicroBatcher:
                     )
             except Exception as err:  # metrics hooks must not fail verdicts
                 log.error("batch stats hook failed", err)
+
+    def _collect_blob(self, record: _WindowRecord) -> None:
+        """Collect one blob window: resolve its single future with the
+        verdict list, feed the breaker hooks, and (only when a rollout
+        is actually shadowing this engine) materialize the requests for
+        the shadow mirror."""
+        bw: _BlobWindow = record.window
+        g = record.groups[0]
+        if g.error is None and g.verdicts is None:
+            try:
+                g.verdicts = g.engine.collect(g.inflight)
+            except Exception as err:
+                g.error = err
+        if g.error is not None:
+            self.stats.errors += bw.n_req
+            if g.engine is not None:
+                log.error("blob window evaluation failed", g.error, batch=bw.n_req)
+                self._notify(self.on_engine_error, g.engine, g.error)
+            _resolve(bw.fut.set_exception, g.error)
+            return
+        self._notify(self.on_engine_success, g.engine)
+        inflight = g.inflight
+        serving_s = (
+            getattr(inflight, "host_s", 0.0)
+            + getattr(inflight, "device_s", 0.0)
+            + getattr(inflight, "decode_s", 0.0)
+            if inflight is not None
+            else time.monotonic() - g.t_dispatch
+        )
+        _resolve(bw.fut.set_result, list(g.verdicts))
+        if self.on_window is not None and (
+            self.window_wanted is None or self._wants_window(g.engine)
+        ):
+            from ..native import blob_requests
+
+            try:
+                reqs = blob_requests(bw.blob, bw.n_req)
+            except Exception as err:
+                log.error("blob window mirror materialization failed", err)
+                reqs = None
+            if reqs is not None:
+                self._notify(
+                    self.on_window, g.engine, reqs, list(g.verdicts), serving_s
+                )
+        try:
+            self.stats.record(bw.n_req, time.monotonic() - g.t_dispatch)
+            if inflight is not None:
+                self.stats.record_stage(
+                    getattr(inflight, "host_s", 0.0),
+                    getattr(inflight, "device_s", 0.0)
+                    + getattr(inflight, "decode_s", 0.0),
+                )
+        except Exception as err:  # metrics hooks must not fail verdicts
+            log.error("batch stats hook failed", err)
+
+    def _wants_window(self, engine) -> bool:
+        try:
+            return bool(self.window_wanted(engine))
+        except Exception as err:
+            log.error("window_wanted hook failed", err)
+            return False
 
     def _notify(self, hook, *args) -> None:
         """Degraded-mode/metrics hooks are side channels: a raising hook

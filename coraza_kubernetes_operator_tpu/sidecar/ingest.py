@@ -1,0 +1,737 @@
+"""Asyncio-native zero-copy ingest frontend (docs/SERVING.md).
+
+The legacy ``ThreadingHTTPServer`` frontend spends the serving budget on
+per-connection threads and per-request Python object churn long before a
+request reaches the pipelined batcher and the C++ tensorizer — DPI data
+planes are ingest-bound before the matcher saturates. This module
+replaces it with a single-acceptor asyncio loop (uvloop when importable,
+stdlib event loop otherwise):
+
+- **HTTP/1.1 keep-alive + pipelining**: one reader coroutine parses
+  requests incrementally off each connection; one writer coroutine
+  streams responses back in arrival order (pipelined requests answer
+  in order, as HTTP requires).
+- **Zero-copy window assembly**: filter-mode request bytes are sliced
+  straight off the wire into the length-prefixed batch-blob format
+  ``native.serialize_requests`` defines. A full ingest window reaches
+  ``cko_tensorize`` as one contiguous blob via
+  ``MicroBatcher.submit_window`` — zero per-request ``HttpRequest``
+  materialization on the hot path.
+- **Python path preserved** for everything the blob path cannot carry:
+  per-request deadlines (X-CKO-Deadline-Ms), tenant routing
+  (trust_tenant_header), the control endpoints, and bulk mode. Those
+  run ``TpuEngineSidecar``'s shared reply builders on worker pools, so
+  verdict mapping cannot drift from the threaded frontend.
+- **Liveness is never queued**: /waf/v1/healthz and readyz answer
+  inline on the event loop; stats/metrics/rollback run on a dedicated
+  small control pool separate from the evaluation pool, so a saturated
+  prepare queue cannot starve probes.
+
+Degraded-mode contracts are preserved window-at-a-time: breaker-open
+and engine-unavailable windows answer per failurePolicy, queue-budget
+shedding answers 429 with Retry-After (cko_shed_total stays
+per-request), and device failures re-answer from the host fallback
+exactly like the threaded path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import struct
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from http.client import responses as _REASONS
+
+from ..engine.request import HttpRequest
+from ..utils import get_logger
+from .batcher import EngineUnavailable
+from .degraded import BreakerOpen, Overloaded
+
+log = get_logger("sidecar.ingest")
+
+API_PREFIX = "/waf/v1/"
+# Maximum bytes of request head (request line + headers). The threaded
+# reference caps individual lines at 64 KiB; the async parser caps the
+# whole head — past it the request answers 400 and the connection closes.
+MAX_HEAD_BYTES = 65536
+# Per-connection cap on pipelined responses not yet written back; the
+# reader pauses (TCP backpressure) once a client is this far ahead.
+MAX_PIPELINED = 256
+
+_METHODS_WITH_BODY = {b"POST", b"PUT", b"PATCH", b"DELETE"}
+_KNOWN_METHODS = {b"GET"} | _METHODS_WITH_BODY
+# Headers the router needs by name; everything else is carried as raw
+# bytes into the blob untouched.
+_SPECIAL = {
+    b"content-length",
+    b"transfer-encoding",
+    b"connection",
+    b"x-cko-deadline-ms",
+    b"x-waf-tenant",
+    b"authorization",
+}
+_pack = struct.pack
+
+
+def _parse_head(head: bytes):
+    """Parse request line + headers from a ``\\r\\n\\r\\n``-terminated head.
+
+    Returns ``(method, target, version, header_pairs, special)`` with every
+    field as raw bytes (the blob hot path must not round-trip through str),
+    or None when malformed. ``special`` maps lowercased names from
+    ``_SPECIAL`` to their FIRST occurrence (http.client semantics).
+    """
+    head = head[:-4]
+    # RFC 7230 §3.5 robustness: ignore blank line(s) before the request line.
+    while head.startswith(b"\r\n"):
+        head = head[2:]
+    lines = head.split(b"\r\n")
+    parts = lines[0].split()
+    if len(parts) != 3 or not parts[2].startswith(b"HTTP/"):
+        return None
+    pairs: list[tuple[bytes, bytes]] = []
+    for ln in lines[1:]:
+        if not ln:
+            continue
+        if ln[0:1] in (b" ", b"\t") and pairs:  # obs-fold continuation
+            k, v = pairs[-1]
+            pairs[-1] = (k, v + b" " + ln.strip())
+            continue
+        i = ln.find(b":")
+        if i <= 0:
+            return None
+        pairs.append((ln[:i].strip(), ln[i + 1 :].strip()))
+    special: dict[bytes, bytes] = {}
+    for k, v in pairs:
+        lk = k.lower()
+        if lk in _SPECIAL and lk not in special:
+            special[lk] = v
+    return parts[0], parts[1], parts[2], pairs, special
+
+
+def _deadline_from(special: dict) -> float | None:
+    """Absolute monotonic deadline from X-CKO-Deadline-Ms (threaded
+    ``_Handler._deadline_s`` semantics: unparsable or <=0 means none)."""
+    raw = special.get(b"x-cko-deadline-ms")
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    if ms <= 0:
+        return None
+    return _time.monotonic() + ms / 1e3
+
+
+def _materialize(
+    method: bytes, target_s: str, version: bytes, pairs, body: bytes, remote_b: bytes
+) -> HttpRequest:
+    return HttpRequest(
+        method=method.decode("latin-1", "replace"),
+        uri=target_s,
+        version=version.decode("latin-1", "replace"),
+        headers=[
+            (k.decode("latin-1", "replace"), v.decode("latin-1", "replace"))
+            for k, v in pairs
+        ],
+        body=body,
+        remote_addr=remote_b.decode("latin-1", "replace"),
+    )
+
+
+class AsyncIngestFrontend:
+    """Single-acceptor asyncio HTTP/1.1 frontend for TpuEngineSidecar."""
+
+    def __init__(self, sidecar):
+        self.sidecar = sidecar
+        cfg = sidecar.config
+        # Bind eagerly so ``sidecar.port`` is known before start() (the
+        # threaded frontend binds in its constructor too).
+        self._sock = socket.create_server((cfg.host, cfg.port), backlog=1024)
+        self._sock.setblocking(False)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._stopping = False
+        workers = int(os.environ.get("CKO_INGEST_WORKERS", "32") or 32)
+        # Evaluation pool (bulk mode, Python-path filter requests,
+        # fallback windows) is separate from the tiny control pool
+        # (stats/metrics/rollback) so operator probes never queue behind
+        # saturated evaluation threads.
+        self._eval_pool = ThreadPoolExecutor(
+            max_workers=max(1, workers), thread_name_prefix="cko-ingest-eval"
+        )
+        self._ctl_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="cko-ingest-ctl"
+        )
+        # Window under assembly. Loop-thread only — no locks anywhere on
+        # the hot path.
+        self._win_buf = bytearray()
+        self._win_futs: list[asyncio.Future] = []
+        self._win_timer: asyncio.TimerHandle | None = None
+        self._inflight_windows = 0
+        # Counters (written on the loop thread; racy cross-thread reads
+        # are fine for metrics).
+        self.loop_impl = "asyncio"
+        self.connections = 0
+        self.connections_total = 0
+        self.requests_total = 0
+        self.bytes_total = 0
+        self.parse_s = 0.0
+        self.windows_total = 0
+        self.window_requests_total = 0
+        self.python_path_requests_total = 0
+        self._render_cache: dict = {}
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="sidecar-ingest", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30) or self._loop is None:
+            raise RuntimeError("async ingest frontend failed to start")
+
+    def _run(self) -> None:
+        try:
+            import uvloop  # type: ignore[import-not-found]
+
+            loop = uvloop.new_event_loop()
+            self.loop_impl = "uvloop"
+        except Exception:  # uvloop not baked into every image
+            loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            self._server = loop.run_until_complete(
+                asyncio.start_server(
+                    self._handle_conn, sock=self._sock, limit=MAX_HEAD_BYTES
+                )
+            )
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(self._drain())
+        except Exception as err:
+            log.error("ingest loop failed", err)
+            self._started.set()
+        finally:
+            try:
+                loop.close()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        if self._loop is None or self._stopping:
+            return
+        self._stopping = True
+
+        def halt():
+            if self._server is not None:
+                self._server.close()
+            self._flush_window()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(halt)
+        except RuntimeError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._eval_pool.shutdown(wait=False)
+        self._ctl_pool.shutdown(wait=False)
+
+    async def _drain(self) -> None:
+        """Bounded shutdown drain: dispatched windows get a moment to
+        resolve so queued clients see answers instead of resets."""
+        deadline = self._loop.time() + 2.0
+        while self._inflight_windows > 0 and self._loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        current = asyncio.current_task(self._loop)
+        tasks = [t for t in asyncio.all_tasks(self._loop) if t is not current]
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            # Let the cancellations unwind (connection handlers close
+            # their writers) before the loop closes underneath them.
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*tasks, return_exceptions=True), timeout=2.0
+                )
+            except (asyncio.TimeoutError, Exception):
+                pass
+
+    # -- connection handling -------------------------------------------------
+
+    async def _handle_conn(self, reader, writer) -> None:
+        self.connections += 1
+        self.connections_total += 1
+        queue: asyncio.Queue = asyncio.Queue()
+        rtask = asyncio.ensure_future(self._read_requests(reader, writer, queue))
+        # Reliable writer wakeup on EOF/parse-exit: the queue is unbounded
+        # (reader throttles on qsize) so the sentinel can never be lost.
+        rtask.add_done_callback(lambda _t: queue.put_nowait(None))
+        try:
+            await self._write_responses(queue, writer)
+        finally:
+            rtask.cancel()
+            try:
+                await rtask
+            except (asyncio.CancelledError, Exception):
+                pass
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+            self.connections -= 1
+
+    async def _read_requests(self, reader, writer, queue) -> None:
+        peer = writer.get_extra_info("peername")
+        remote_b = (peer[0] if isinstance(peer, tuple) and peer else "").encode(
+            "latin-1", "replace"
+        )
+        while True:
+            try:
+                head = await reader.readuntil(b"\r\n\r\n")
+            except asyncio.IncompleteReadError as err:
+                if err.partial.strip():
+                    self._put_static(queue, 400, b"bad request\n")
+                return
+            except asyncio.LimitOverrunError:
+                self._put_static(queue, 400, b"request head too large\n")
+                return
+            except (ConnectionError, OSError):
+                return
+            t0 = _time.perf_counter()
+            parsed = _parse_head(head)
+            self.parse_s += _time.perf_counter() - t0
+            if parsed is None:
+                self._put_static(queue, 400, b"bad request\n")
+                return
+            method, target, version, pairs, special = parsed
+            if method not in _KNOWN_METHODS:
+                self._put_static(queue, 501, b"unsupported method\n")
+                return
+            # -- body ---------------------------------------------------------
+            body = b""
+            close_after = False
+            if b"chunked" in special.get(b"transfer-encoding", b"").lower():
+                body, malformed = await self._read_chunked(reader)
+                # Lenient decode mirrors the threaded parser; after a
+                # malformed chunk the connection framing is unknowable,
+                # so answer what was decoded, then close.
+                close_after = malformed
+            else:
+                cl = special.get(b"content-length")
+                if cl:
+                    try:
+                        length = int(cl)
+                    except ValueError:
+                        self._put_static(queue, 400, b"bad content-length\n")
+                        return
+                    if length > 0:
+                        try:
+                            body = await reader.readexactly(length)
+                        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                            return
+            self.bytes_total += len(head) + len(body)
+            self.requests_total += 1
+            conn_tok = special.get(b"connection", b"").lower()
+            if version == b"HTTP/1.1":
+                keep_alive = b"close" not in conn_tok
+            else:
+                keep_alive = b"keep-alive" in conn_tok
+            if close_after:
+                keep_alive = False
+            fut = self._route(method, target, version, pairs, special, body, remote_b)
+            queue.put_nowait((fut, keep_alive))
+            if not keep_alive:
+                return
+            if queue.qsize() >= MAX_PIPELINED:
+                # Pipelining backpressure: stop reading until the writer
+                # catches up (the client feels it as TCP backpressure).
+                while queue.qsize() >= MAX_PIPELINED // 2:
+                    await asyncio.sleep(0.001)
+
+    async def _read_chunked(self, reader) -> tuple[bytes, bool]:
+        """Lenient chunked decode (threaded ``_read_chunked`` semantics:
+        an unparsable size line stops decoding and evaluates what
+        arrived). Returns (body, malformed)."""
+        chunks: list[bytes] = []
+        while True:
+            try:
+                size_line = await reader.readline()
+            except (ValueError, ConnectionError, OSError):
+                return b"".join(chunks), True
+            try:
+                size = int(size_line.strip().split(b";", 1)[0], 16)
+            except ValueError:
+                return b"".join(chunks), True
+            if size == 0:
+                try:
+                    while (await reader.readline()).strip():  # trailers
+                        pass
+                except (ValueError, ConnectionError, OSError):
+                    pass
+                return b"".join(chunks), False
+            try:
+                chunks.append(await reader.readexactly(size))
+                await reader.readline()  # CRLF after chunk data
+            except (asyncio.IncompleteReadError, ValueError, ConnectionError, OSError):
+                return b"".join(chunks), True
+
+    async def _write_responses(self, queue, writer) -> None:
+        try:
+            while True:
+                item = await queue.get()
+                if item is None:
+                    return
+                fut, keep_alive = item
+                try:
+                    status, payload, headers = await fut
+                except asyncio.CancelledError:
+                    raise
+                except Exception as err:
+                    log.error("ingest response future failed", err)
+                    status, payload, headers = (
+                        500,
+                        b"internal error\n",
+                        {"Content-Type": "text/plain"},
+                    )
+                writer.write(self._render(status, payload, headers, keep_alive))
+                if queue.empty():
+                    await writer.drain()
+                if not keep_alive:
+                    return
+        except (ConnectionError, OSError):
+            pass
+
+    def _render(self, status, payload, headers, keep_alive) -> bytes:
+        cacheable = len(payload) <= 256
+        if cacheable:
+            key = (status, payload, tuple(headers.items()), keep_alive)
+            cached = self._render_cache.get(key)
+            if cached is not None:
+                return cached
+        reason = _REASONS.get(status, "")
+        parts = [f"HTTP/1.1 {status} {reason}\r\nServer: cko-tpu-engine\r\n"]
+        for k, v in headers.items():
+            parts.append(f"{k}: {v}\r\n")
+        parts.append(f"Content-Length: {len(payload)}\r\n")
+        if not keep_alive:
+            parts.append("Connection: close\r\n")
+        parts.append("\r\n")
+        out = "".join(parts).encode("latin-1", "replace") + payload
+        if cacheable and len(self._render_cache) < 256:
+            self._render_cache[key] = out
+        return out
+
+    def _put_static(self, queue, status: int, payload: bytes) -> None:
+        fut = self._loop.create_future()
+        fut.set_result((status, payload, {"Content-Type": "text/plain"}))
+        queue.put_nowait((fut, False))
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, method, target, version, pairs, special, body, remote_b):
+        sc = self.sidecar
+        target_s = target.decode("latin-1", "replace")
+        path = target_s.split("?", 1)[0]
+        if path.startswith(API_PREFIX):
+            return self._route_api(method, path, special, body)
+        # -- filter mode ------------------------------------------------------
+        # Threaded parity: GET bodies are consumed for framing but not
+        # evaluated (do_GET calls _handle_filter(b"")).
+        eval_body = body if method != b"GET" else b""
+        deadline_s = _deadline_from(special)
+        if deadline_s is not None or sc.config.trust_tenant_header:
+            # Python path: per-request deadlines and tenant routing need
+            # the object pipeline (per-tenant engines, deadline-aware
+            # fallback rescue).
+            self.python_path_requests_total += 1
+            tenant = None
+            if sc.config.trust_tenant_header:
+                t = special.get(b"x-waf-tenant")
+                tenant = t.decode("latin-1", "replace") if t else None
+            req = _materialize(method, target_s, version, pairs, eval_body, remote_b)
+            return self._spawn(self._eval_pool, sc.filter_reply, req, tenant, deadline_s)
+        # -- hot path: slice the wire bytes straight into the native
+        # batch-blob record (native.serialize_requests wire format; zero
+        # HttpRequest materialization).
+        t0 = _time.perf_counter()
+        buf = self._win_buf
+        buf += _pack("<I", len(method))
+        buf += method
+        buf += _pack("<I", len(target))
+        buf += target
+        buf += _pack("<I", len(version))
+        buf += version
+        buf += _pack("<I", len(pairs))
+        for k, v in pairs:
+            buf += _pack("<I", len(k))
+            buf += k
+            buf += _pack("<I", len(v))
+            buf += v
+        buf += _pack("<I", len(eval_body))
+        buf += eval_body
+        buf += _pack("<I", len(remote_b))
+        buf += remote_b
+        fut = self._loop.create_future()
+        self._win_futs.append(fut)
+        self.parse_s += _time.perf_counter() - t0
+        if len(self._win_futs) >= sc.config.max_batch_size:
+            self._flush_window()
+        elif self._win_timer is None:
+            delay = max(sc.config.max_batch_delay_ms, 0.0) / 1e3
+            self._win_timer = self._loop.call_later(delay, self._flush_window)
+        return fut
+
+    def _route_api(self, method, path, special, body):
+        sc = self.sidecar
+        if method == b"GET":
+            if path == API_PREFIX + "healthz":
+                return self._done(sc.healthz_reply())
+            if path == API_PREFIX + "readyz":
+                return self._done(sc.readyz_reply())
+            if path == API_PREFIX + "stats":
+                return self._spawn(self._ctl_pool, self._stats_reply)
+            if path == API_PREFIX + "metrics":
+                auth = special.get(b"authorization")
+                return self._spawn(
+                    self._ctl_pool,
+                    sc.metrics_reply,
+                    auth.decode("latin-1", "replace") if auth else None,
+                )
+        else:
+            if path == API_PREFIX + "evaluate":
+                t = special.get(b"x-waf-tenant")
+                return self._spawn(
+                    self._eval_pool,
+                    sc.bulk_reply,
+                    body,
+                    t.decode("latin-1", "replace") if t else None,
+                    _deadline_from(special),
+                )
+            if path == API_PREFIX + "rollback":
+                return self._spawn(self._ctl_pool, sc.rollback_reply, body)
+        return self._done(
+            (
+                404,
+                json.dumps({"error": "not found"}).encode(),
+                {"Content-Type": "application/json"},
+            )
+        )
+
+    def _stats_reply(self):
+        return (
+            200,
+            json.dumps(self.sidecar.stats()).encode(),
+            {"Content-Type": "application/json"},
+        )
+
+    def _done(self, reply) -> asyncio.Future:
+        fut = self._loop.create_future()
+        fut.set_result(reply)
+        return fut
+
+    def _spawn(self, pool, fn, *args) -> asyncio.Future:
+        """Run a blocking reply builder on a worker pool; resolve the
+        response future back on the loop thread."""
+        fut = self._loop.create_future()
+
+        def run():
+            try:
+                reply = fn(*args)
+            except Exception as err:
+                log.error("ingest handler failed", err)
+                reply = (
+                    500,
+                    json.dumps(
+                        {"error": f"internal error: {type(err).__name__}"}
+                    ).encode(),
+                    {"Content-Type": "application/json"},
+                )
+            self._call_soon(self._resolve, fut, reply)
+
+        try:
+            pool.submit(run)
+        except RuntimeError:  # pool shut down mid-stop
+            fut.set_result((503, b"shutting down\n", {"Content-Type": "text/plain"}))
+        return fut
+
+    def _call_soon(self, fn, *args) -> None:
+        try:
+            self._loop.call_soon_threadsafe(fn, *args)
+        except RuntimeError:  # loop closed during shutdown
+            pass
+
+    @staticmethod
+    def _resolve(fut: asyncio.Future, reply) -> None:
+        if not fut.done():
+            fut.set_result(reply)
+
+    # -- window assembly + dispatch -------------------------------------------
+
+    def _flush_window(self) -> None:
+        if self._win_timer is not None:
+            self._win_timer.cancel()
+            self._win_timer = None
+        futs = self._win_futs
+        if not futs:
+            return
+        blob = bytes(self._win_buf)
+        self._win_futs = []
+        self._win_buf = bytearray()
+        self.windows_total += 1
+        self.window_requests_total += len(futs)
+        self._dispatch_window(blob, futs)
+
+    def _dispatch_window(self, blob: bytes, futs: list) -> None:
+        """Route one assembled window. Runs on the loop thread — every
+        step here is a cheap probe; blocking work goes to the batcher or
+        the evaluation pool."""
+        sc = self.sidecar
+        engine = sc.tenants.engine_for(None)
+        if engine is None:
+            self._answer_all(futs, sc.unavailable_reply)
+            return
+        try:
+            route = sc.degraded.route(engine)
+        except BreakerOpen:
+            self._answer_all(futs, sc.breaker_filter_reply)
+            return
+        if route == "fallback":
+            self._inflight_windows += 1
+            self._submit_eval(self._fallback_window, engine, blob, futs)
+            return
+        try:
+            sc._admit_device(len(futs))
+        except Overloaded as err:
+            reply = sc.overloaded_reply(err, as_json=False)
+            self._answer_all(futs, lambda: reply)
+            return
+        self._inflight_windows += 1
+        wfut = sc.batcher.submit_window(blob, len(futs))
+        # Same budget ladder as the threaded bulk path: cold engines get
+        # the compile budget; warmed ones the strict timeout plus a
+        # bounded recompile grace (fresh-shape tier buckets mid-stream).
+        timeout = sc._timeout_for([engine])
+        if timeout <= sc.config.request_timeout_s:
+            timeout += max(0.0, sc.config.recompile_grace_s)
+        handle = self._loop.call_later(timeout, self._window_timeout, wfut, futs)
+        wfut.add_done_callback(
+            lambda f: self._call_soon(self._window_done, f, futs, blob, engine, handle)
+        )
+
+    def _window_timeout(self, wfut, futs) -> None:
+        # Threaded-path legacy-timeout contract: the failurePolicy
+        # answers. Cancel so the batcher skips the window if still queued.
+        wfut.cancel()
+        self._answer_all(futs, self.sidecar.unavailable_reply)
+
+    def _window_done(self, wfut, futs, blob, engine, handle) -> None:
+        self._inflight_windows -= 1
+        handle.cancel()
+        sc = self.sidecar
+        if wfut.cancelled():
+            self._answer_all(futs, sc.unavailable_reply)
+            return
+        err = wfut.exception()
+        if err is None:
+            verdicts = wfut.result()
+            for f, v in zip(futs, verdicts):
+                if not f.done():
+                    f.set_result(sc.verdict_filter_reply(v))
+            # Batch accounting (verdict counters + audit from the blob)
+            # off the loop thread.
+            self._submit_eval(sc.record_window, engine, blob, verdicts)
+            return
+        if isinstance(err, EngineUnavailable):
+            self._answer_all(futs, sc.unavailable_reply)
+            return
+        if isinstance(err, BreakerOpen):
+            self._answer_all(futs, sc.breaker_filter_reply)
+            return
+        if isinstance(err, Overloaded):
+            reply = sc.overloaded_reply(err, as_json=False)
+            self._answer_all(futs, lambda: reply)
+            return
+        # Device failure: same rescue as the threaded path — re-answer
+        # from the host fallback when enabled, else the failurePolicy.
+        log.error("ingest window device path failed", err)
+        if sc.degraded.fallback_enabled:
+            self._inflight_windows += 1
+            self._submit_eval(self._fallback_window, engine, blob, futs)
+            return
+        self._answer_all(futs, sc.unavailable_reply)
+
+    def _fallback_window(self, engine, blob: bytes, futs: list) -> None:
+        """Host-fallback evaluation of a whole window (evaluation pool
+        thread): materialize the blob, evaluate on the scalar path, and
+        answer with the identical per-request accounting the threaded
+        frontend performs."""
+        sc = self.sidecar
+        try:
+            from ..native import blob_requests
+
+            reqs = blob_requests(blob, len(futs))
+            verdicts = sc._fallback_eval(engine, reqs)
+            replies = []
+            for r, v in zip(reqs, verdicts):
+                sc.record_verdict(r, v)
+                replies.append(sc.verdict_filter_reply(v))
+        except Overloaded as oerr:
+            replies = [sc.overloaded_reply(oerr, as_json=False)] * len(futs)
+        except Exception as err:
+            log.error("ingest window fallback failed", err)
+            replies = [sc.unavailable_reply() for _ in futs]
+
+        def finish():
+            self._inflight_windows -= 1
+            for f, r in zip(futs, replies):
+                if not f.done():
+                    f.set_result(r)
+
+        self._call_soon(finish)
+
+    def _answer_all(self, futs, builder) -> None:
+        # Builder is invoked once per unanswered request: unavailable/
+        # breaker replies count fail-opens per request, same as the
+        # threaded per-request handlers.
+        for f in futs:
+            if not f.done():
+                f.set_result(builder())
+
+    def _submit_eval(self, fn, *args) -> None:
+        try:
+            self._eval_pool.submit(fn, *args)
+        except RuntimeError:  # pool shut down mid-stop
+            pass
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "mode": "async",
+            "loop": self.loop_impl,
+            "connections": self.connections,
+            "connections_total": self.connections_total,
+            "requests_total": self.requests_total,
+            "bytes_total": self.bytes_total,
+            "parse_s": round(self.parse_s, 6),
+            "windows": self.windows_total,
+            "window_requests": self.window_requests_total,
+            "python_path_requests": self.python_path_requests_total,
+            "inflight_windows": self._inflight_windows,
+        }

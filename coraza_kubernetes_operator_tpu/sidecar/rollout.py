@@ -420,6 +420,14 @@ class RolloutManager:
             if not lst:
                 self._by_baseline.pop(id(r.baseline), None)
 
+    def wants_window(self, engine) -> bool:
+        """Batcher hook (``MicroBatcher.window_wanted``): True only when a
+        rollout is actively shadowing against this serving engine. Lets
+        blob windows skip request materialization when nobody is
+        listening — the async zero-copy path stays zero-copy."""
+        with self._lock:
+            return bool(self._by_baseline.get(id(engine)))
+
     def mirror_window(self, engine, requests, verdicts, serving_s: float) -> None:
         """Batcher hook (``MicroBatcher.on_window``): offer a collected
         window to every rollout shadowing against this serving engine.

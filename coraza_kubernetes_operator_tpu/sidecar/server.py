@@ -107,6 +107,12 @@ class SidecarConfig:
     pipeline_depth: int | None = None
     host: str = "0.0.0.0"
     port: int = 9090
+    # Ingest frontend (docs/SERVING.md): "async" is the asyncio-native
+    # single-acceptor loop with keep-alive + pipelining and zero-copy
+    # window assembly straight into the native batch-blob format;
+    # "threaded" is the legacy ThreadingHTTPServer (one thread per
+    # connection) kept as an escape hatch and as the parity reference.
+    frontend: str = "async"
     request_timeout_s: float = 30.0
     # First-evaluation budget while an engine's XLA executables are still
     # compiling (VERDICT r4 missing #2: request_timeout_s fired mid-compile
@@ -208,6 +214,12 @@ def verdict_to_json(v: Verdict) -> dict:
     }
 
 
+def _json_reply(status: int, obj, headers: dict | None = None) -> tuple[int, bytes, dict]:
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    return status, json.dumps(obj).encode(), h
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "cko-tpu-engine"
@@ -269,19 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == API_PREFIX + "stats":
             self._reply_json(200, self.sidecar.stats())
         elif path == API_PREFIX + "metrics":
-            import hmac
-
-            token = self.sidecar.config.metrics_auth_token
-            presented = self.headers.get("Authorization") or ""
-            if token and not hmac.compare_digest(
-                presented.encode(), f"Bearer {token}".encode()
-            ):
-                self._reply_json(401, {"error": "unauthorized"})
-                return
             self._reply(
-                200,
-                self.sidecar.render_metrics().encode(),
-                {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+                *self.sidecar.metrics_reply(self.headers.get("Authorization"))
             )
         elif path.startswith(API_PREFIX):
             self._reply_json(404, {"error": "not found"})
@@ -312,44 +313,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, b"ok\n", {"Content-Type": "text/plain"})
 
     def _handle_readyz(self) -> None:
-        if not self.sidecar.ready():
-            self._reply(
-                503, b"not ready: no ruleset loaded\n", {"Content-Type": "text/plain"}
-            )
-            return
-        mode = self.sidecar.serving_mode()
-        if mode == MODE_BROKEN:
-            # Device path broken (breaker open): even though the host
-            # fallback may still answer, pull this replica from rotation —
-            # healthy replicas serve at device speed; a broken one sheds
-            # under any real load.
-            self._reply(
-                503,
-                b"not ready: device path broken\n",
-                {"Content-Type": "text/plain"},
-            )
-            return
-        self._reply(200, f"ok mode={mode}\n".encode(), {"Content-Type": "text/plain"})
+        self._reply(*self.sidecar.readyz_reply())
 
     def _handle_rollback(self, body: bytes) -> None:
-        """Force the serving engine back to the previous last-known-good
-        ring entry (docs/ROLLOUT.md). Optional JSON body {"tenant": key};
-        default tenant otherwise. 409 when there is nothing to roll back
-        to (empty ring / unknown tenant)."""
-        tenant = None
-        if body:
-            try:
-                tenant = (json.loads(body.decode("utf-8")) or {}).get("tenant")
-            except (ValueError, AttributeError):
-                self._reply_json(400, {"error": "invalid rollback payload"})
-                return
-        result = self.sidecar.force_rollback(tenant)
-        if result is None:
-            self._reply_json(
-                409, {"error": "nothing to roll back to (last-known-good ring empty)"}
-            )
-            return
-        self._reply_json(200, {**result, "mode": self.sidecar.serving_mode(tenant)})
+        self._reply(*self.sidecar.rollback_reply(body))
 
     def _deadline_s(self) -> float | None:
         """Absolute monotonic deadline from the X-CKO-Deadline-Ms header."""
@@ -364,16 +331,6 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         return _time.monotonic() + ms / 1e3
 
-    def _overloaded(self, err: Overloaded, as_json: bool) -> None:
-        retry = max(1, int(err.retry_after_s + 0.999))
-        headers = {"Retry-After": str(retry)}
-        if as_json:
-            self._reply_json(429, {"error": f"overloaded: {err}"}, headers)
-        else:
-            headers["Content-Type"] = "text/plain"
-            headers["x-waf-action"] = "shed"
-            self._reply(429, b"WAF overloaded, retry later\n", headers)
-
     def _handle_filter(self, body: bytes) -> None:
         req = HttpRequest(
             method=self.command,
@@ -386,163 +343,18 @@ class _Handler(BaseHTTPRequestHandler):
         tenant = None
         if self.sidecar.config.trust_tenant_header:
             tenant = self.headers.get(TENANT_HEADER) or None
-        try:
-            verdict = self.sidecar.evaluate(
-                req, tenant=tenant, deadline_s=self._deadline_s()
-            )
-        except Overloaded as err:
-            self._overloaded(err, as_json=False)
-            return
-        except BreakerOpen:
-            self._breaker_open_filter()
-            return
-        except EngineUnavailable:
-            self._unavailable()
-            return
-        except Exception as err:  # evaluation failure → failurePolicy
-            log.error("filter evaluation failed", err)
-            self._unavailable()
-            return
-        self.sidecar.record_verdict(req, verdict, tenant=tenant)
-        if verdict.interrupted:
-            self._reply(
-                verdict.status,
-                b"blocked by WAF\n",
-                {
-                    "Content-Type": "text/plain",
-                    "x-waf-action": "deny",
-                    "x-waf-rule-id": str(verdict.rule_id or 0),
-                },
-            )
-        else:
-            self._reply(
-                200,
-                b"allowed\n",
-                {"Content-Type": "text/plain", "x-waf-action": "allow"},
-            )
-
-    def _handle_bulk(self, body: bytes) -> None:
-        # Tenant selection (header or per-request field) is gated behind the
-        # same trust_tenant_header switch as filter mode: the bulk API shares
-        # the unauthenticated listener, so without the explicit opt-in a
-        # caller must not be able to probe arbitrary tenants' rulesets.
-        trust = self.sidecar.config.trust_tenant_header
-        default_tenant = (self.headers.get(TENANT_HEADER) or None) if trust else None
-
-        deadline_s = self._deadline_s()
-
-        # Fast path (the ≥100k req/s serving contract): single-tenant
-        # deployments hand the raw JSON body to the native ingest — C++
-        # parses, extracts, transforms, and packs rows; Python tiers,
-        # dispatches the device step, and streams the verdict array.
-        # Falls through to the object path for tenant routing, when the
-        # serving mode is degraded (fallback/broken), or when the native
-        # parse rejects the payload (schema errors then get their
-        # descriptive 400 from the Python path).
-        if not trust:
-            try:
-                fast = self.sidecar.evaluate_bulk_fast(body)
-            except BreakerOpen:
-                fast = None
-            if fast is not None:
-                self._reply_json(
-                    200, {"verdicts": fast, "mode": self.sidecar.serving_mode()}
-                )
-                return
-
-        try:
-            payload = json.loads(body.decode("utf-8"))
-            reqs = [request_from_json(o) for o in payload["requests"]]
-            tenants = [
-                (o.get("tenant") or default_tenant) if trust else None
-                for o in payload["requests"]
-            ]
-        except (ValueError, KeyError, TypeError, AttributeError) as err:
-            self._reply_json(400, {"error": f"invalid request payload: {err}"})
-            return
-        try:
-            verdicts = self.sidecar.evaluate_many(
-                reqs, tenants=tenants, deadline_s=deadline_s
-            )
-        except Overloaded as err:
-            self._overloaded(err, as_json=True)
-            return
-        except BreakerOpen:
-            self._breaker_open_bulk(reqs, tenants)
-            return
-        except EngineUnavailable:
-            self._unavailable()
-            return
-        except Exception as err:  # evaluation failure: explicit 500, not a
-            log.error("bulk evaluation failed", err)  # dropped connection
-            # Always name the exception type: TimeoutError's str() is empty
-            # and a blank error message erases the diagnosis (VERDICT r4
-            # weak #5).
-            self._reply_json(
-                500, {"error": f"evaluation failed: {type(err).__name__}: {err}"}
-            )
-            return
-        for r, v, t in zip(reqs, verdicts, tenants):
-            self.sidecar.record_verdict(r, v, tenant=t)
-        self._reply_json(
-            200,
-            {
-                "verdicts": [verdict_to_json(v) for v in verdicts],
-                "mode": self.sidecar.serving_mode(),
-            },
+        self._reply(
+            *self.sidecar.filter_reply(req, tenant=tenant, deadline_s=self._deadline_s())
         )
 
-    def _unavailable(self) -> None:
-        # Fail-open: pass the request through unevaluated. Fail-closed: 503.
-        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
-            self.sidecar.count_failopen()
-            self._reply(
-                200,
-                b"allowed (fail-open: no ruleset loaded)\n",
-                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
+    def _handle_bulk(self, body: bytes) -> None:
+        self._reply(
+            *self.sidecar.bulk_reply(
+                body,
+                tenant_header=self.headers.get(TENANT_HEADER),
+                deadline_s=self._deadline_s(),
             )
-        else:
-            self._reply(
-                503,
-                b"WAF unavailable (fail-closed)\n",
-                {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
-            )
-
-    def _breaker_open_filter(self) -> None:
-        """Circuit breaker open with no fallback evaluator: the Engine
-        failurePolicy decides. ``fail`` denies by default (403 — the WAF
-        is refusing traffic it cannot evaluate, not erroring), ``allow``
-        passes through and counts the fail-open."""
-        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
-            self.sidecar.count_failopen()
-            self._reply(
-                200,
-                b"allowed (fail-open: breaker open)\n",
-                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
-            )
-        else:
-            self._reply(
-                403,
-                b"blocked by WAF (fail-closed: breaker open)\n",
-                {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
-            )
-
-    def _breaker_open_bulk(self, reqs, tenants) -> None:
-        if self.sidecar.config.failure_policy == FAILURE_POLICY_ALLOW:
-            self.sidecar.count_failopen(len(reqs))
-            allow = Verdict(interrupted=False, status=200, rule_id=None)
-            self._reply_json(
-                200,
-                {
-                    "verdicts": [verdict_to_json(allow) for _ in reqs],
-                    "mode": "fail-open",
-                },
-            )
-        else:
-            self._reply_json(
-                503,
-                {"error": "WAF unavailable (fail-closed: circuit breaker open)"},
-            )
+        )
 
 
 class _Server(ThreadingHTTPServer):
@@ -597,6 +409,10 @@ class TpuEngineSidecar:
             # Mirror collected windows into any shadowing candidate
             # (cheap dict probe when no rollout is active).
             self.batcher.on_window = self.rollout.mirror_window
+            # Blob windows only materialize HttpRequests for the mirror
+            # when a rollout is actually shadowing this engine — the
+            # async zero-copy path stays zero-copy otherwise.
+            self.batcher.window_wanted = self.rollout.wants_window
         self.metrics = MetricsRegistry()
         self._m_requests = self.metrics.counter(
             "waf_requests_total", "Evaluated requests by action", ("action",)
@@ -820,9 +636,33 @@ class TpuEngineSidecar:
             self.audit = AuditLogger(
                 path=config.audit_log, relevant_only=config.audit_relevant_only
             )
-        self._httpd = _Server((config.host, config.port), _Handler)
-        self._httpd.sidecar = self  # type: ignore[attr-defined]
+        # -- ingest frontend (docs/SERVING.md) ------------------------------
+        self._httpd: _Server | None = None
+        self._frontend = None
+        if config.frontend == "threaded":
+            self._httpd = _Server((config.host, config.port), _Handler)
+            self._httpd.sidecar = self  # type: ignore[attr-defined]
+        else:
+            from .ingest import AsyncIngestFrontend
+
+            self._frontend = AsyncIngestFrontend(self)
+        self.metrics.gauge(
+            "cko_ingest_connections",
+            "Open connections on the async ingest frontend",
+        ).set_function(lambda: float(self._frontend_stat("connections")))
+        self.metrics.gauge(
+            "cko_ingest_parse_s",
+            "Cumulative seconds spent parsing + blob-packing ingest bytes",
+        ).set_function(lambda: float(self._frontend_stat("parse_s")))
+        self.metrics.gauge(
+            "cko_ingest_bytes_total",
+            "Request bytes read by the async ingest frontend",
+        ).set_function(lambda: float(self._frontend_stat("bytes_total")))
         self._serve_thread: threading.Thread | None = None
+
+    def _frontend_stat(self, field: str):
+        fe = getattr(self, "_frontend", None)
+        return 0 if fe is None else getattr(fe, field, 0)
 
     def _on_batch(self, size: int, latency_s: float) -> None:
         self._m_batches.inc()
@@ -857,6 +697,8 @@ class TpuEngineSidecar:
 
     @property
     def port(self) -> int:
+        if self._frontend is not None:
+            return self._frontend.port
         return self._httpd.server_address[1]
 
     def ready(self) -> bool:
@@ -911,15 +753,258 @@ class TpuEngineSidecar:
     def count_failopen(self, n: int = 1) -> None:
         self._m_failopen.inc(n)
 
-    def _admit_device(self) -> None:
+    # -- frontend-shared reply builders ---------------------------------------
+    # Both frontends (threaded _Handler and the async ingest loop) answer
+    # through these ``(status, payload, headers)`` builders — verdict
+    # mapping, failurePolicy, shedding, and breaker semantics cannot
+    # drift between them because there is exactly one implementation.
+
+    def healthz_reply(self) -> tuple[int, bytes, dict]:
+        # Liveness only: the process is up and answering. Readiness
+        # (ruleset loaded, device/fallback path serviceable) is
+        # /waf/v1/readyz — a liveness probe that fails on "no ruleset
+        # yet" makes Kubernetes restart a healthy pod mid-compile.
+        return 200, b"ok\n", {"Content-Type": "text/plain"}
+
+    def readyz_reply(self) -> tuple[int, bytes, dict]:
+        if not self.ready():
+            return (
+                503,
+                b"not ready: no ruleset loaded\n",
+                {"Content-Type": "text/plain"},
+            )
+        mode = self.serving_mode()
+        if mode == MODE_BROKEN:
+            # Device path broken (breaker open): even though the host
+            # fallback may still answer, pull this replica from rotation —
+            # healthy replicas serve at device speed; a broken one sheds
+            # under any real load.
+            return (
+                503,
+                b"not ready: device path broken\n",
+                {"Content-Type": "text/plain"},
+            )
+        return 200, f"ok mode={mode}\n".encode(), {"Content-Type": "text/plain"}
+
+    def metrics_reply(self, authorization: str | None) -> tuple[int, bytes, dict]:
+        import hmac
+
+        token = self.config.metrics_auth_token
+        presented = authorization or ""
+        if token and not hmac.compare_digest(
+            presented.encode(), f"Bearer {token}".encode()
+        ):
+            return (
+                401,
+                json.dumps({"error": "unauthorized"}).encode(),
+                {"Content-Type": "application/json"},
+            )
+        return (
+            200,
+            self.render_metrics().encode(),
+            {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
+
+    def rollback_reply(self, body: bytes) -> tuple[int, bytes, dict]:
+        """Force the serving engine back to the previous last-known-good
+        ring entry (docs/ROLLOUT.md). Optional JSON body {"tenant": key};
+        default tenant otherwise. 409 when there is nothing to roll back
+        to (empty ring / unknown tenant)."""
+        tenant = None
+        if body:
+            try:
+                tenant = (json.loads(body.decode("utf-8")) or {}).get("tenant")
+            except (ValueError, AttributeError):
+                return _json_reply(400, {"error": "invalid rollback payload"})
+        result = self.force_rollback(tenant)
+        if result is None:
+            return _json_reply(
+                409, {"error": "nothing to roll back to (last-known-good ring empty)"}
+            )
+        return _json_reply(200, {**result, "mode": self.serving_mode(tenant)})
+
+    def overloaded_reply(
+        self, err: Overloaded, as_json: bool
+    ) -> tuple[int, bytes, dict]:
+        retry = max(1, int(err.retry_after_s + 0.999))
+        if as_json:
+            return _json_reply(
+                429, {"error": f"overloaded: {err}"}, {"Retry-After": str(retry)}
+            )
+        return (
+            429,
+            b"WAF overloaded, retry later\n",
+            {
+                "Content-Type": "text/plain",
+                "x-waf-action": "shed",
+                "Retry-After": str(retry),
+            },
+        )
+
+    def unavailable_reply(self) -> tuple[int, bytes, dict]:
+        # Fail-open: pass the request through unevaluated. Fail-closed: 503.
+        if self.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.count_failopen()
+            return (
+                200,
+                b"allowed (fail-open: no ruleset loaded)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
+            )
+        return (
+            503,
+            b"WAF unavailable (fail-closed)\n",
+            {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
+        )
+
+    def breaker_filter_reply(self) -> tuple[int, bytes, dict]:
+        """Circuit breaker open with no fallback evaluator: the Engine
+        failurePolicy decides. ``fail`` denies by default (403 — the WAF
+        is refusing traffic it cannot evaluate, not erroring), ``allow``
+        passes through and counts the fail-open."""
+        if self.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.count_failopen()
+            return (
+                200,
+                b"allowed (fail-open: breaker open)\n",
+                {"Content-Type": "text/plain", "x-waf-action": "fail-open"},
+            )
+        return (
+            403,
+            b"blocked by WAF (fail-closed: breaker open)\n",
+            {"Content-Type": "text/plain", "x-waf-action": "fail-closed"},
+        )
+
+    def verdict_filter_reply(self, verdict: Verdict) -> tuple[int, bytes, dict]:
+        if verdict.interrupted:
+            return (
+                verdict.status,
+                b"blocked by WAF\n",
+                {
+                    "Content-Type": "text/plain",
+                    "x-waf-action": "deny",
+                    "x-waf-rule-id": str(verdict.rule_id or 0),
+                },
+            )
+        return (
+            200,
+            b"allowed\n",
+            {"Content-Type": "text/plain", "x-waf-action": "allow"},
+        )
+
+    def filter_reply(
+        self,
+        req: HttpRequest,
+        tenant: str | None = None,
+        deadline_s: float | None = None,
+    ) -> tuple[int, bytes, dict]:
+        """Filter mode, end to end: evaluate the inbound request and map
+        the verdict (or degraded-mode exception) to the wire reply."""
+        try:
+            verdict = self.evaluate(req, tenant=tenant, deadline_s=deadline_s)
+        except Overloaded as err:
+            return self.overloaded_reply(err, as_json=False)
+        except BreakerOpen:
+            return self.breaker_filter_reply()
+        except EngineUnavailable:
+            return self.unavailable_reply()
+        except Exception as err:  # evaluation failure → failurePolicy
+            log.error("filter evaluation failed", err)
+            return self.unavailable_reply()
+        self.record_verdict(req, verdict, tenant=tenant)
+        return self.verdict_filter_reply(verdict)
+
+    def bulk_reply(
+        self,
+        body: bytes,
+        tenant_header: str | None = None,
+        deadline_s: float | None = None,
+    ) -> tuple[int, bytes, dict]:
+        # Tenant selection (header or per-request field) is gated behind the
+        # same trust_tenant_header switch as filter mode: the bulk API shares
+        # the unauthenticated listener, so without the explicit opt-in a
+        # caller must not be able to probe arbitrary tenants' rulesets.
+        trust = self.config.trust_tenant_header
+        default_tenant = (tenant_header or None) if trust else None
+
+        # Fast path (the ≥100k req/s serving contract): single-tenant
+        # deployments hand the raw JSON body to the native ingest — C++
+        # parses, extracts, transforms, and packs rows; Python tiers,
+        # dispatches the device step, and streams the verdict array.
+        # Falls through to the object path for tenant routing, when the
+        # serving mode is degraded (fallback/broken), or when the native
+        # parse rejects the payload (schema errors then get their
+        # descriptive 400 from the Python path).
+        if not trust:
+            try:
+                fast = self.evaluate_bulk_fast(body)
+            except BreakerOpen:
+                fast = None
+            if fast is not None:
+                return _json_reply(
+                    200, {"verdicts": fast, "mode": self.serving_mode()}
+                )
+
+        try:
+            payload = json.loads(body.decode("utf-8"))
+            reqs = [request_from_json(o) for o in payload["requests"]]
+            tenants = [
+                (o.get("tenant") or default_tenant) if trust else None
+                for o in payload["requests"]
+            ]
+        except (ValueError, KeyError, TypeError, AttributeError) as err:
+            return _json_reply(400, {"error": f"invalid request payload: {err}"})
+        try:
+            verdicts = self.evaluate_many(reqs, tenants=tenants, deadline_s=deadline_s)
+        except Overloaded as err:
+            return self.overloaded_reply(err, as_json=True)
+        except BreakerOpen:
+            return self._breaker_open_bulk_reply(reqs)
+        except EngineUnavailable:
+            return self.unavailable_reply()
+        except Exception as err:  # evaluation failure: explicit 500, not a
+            log.error("bulk evaluation failed", err)  # dropped connection
+            # Always name the exception type: TimeoutError's str() is empty
+            # and a blank error message erases the diagnosis (VERDICT r4
+            # weak #5).
+            return _json_reply(
+                500, {"error": f"evaluation failed: {type(err).__name__}: {err}"}
+            )
+        for r, v, t in zip(reqs, verdicts, tenants):
+            self.record_verdict(r, v, tenant=t)
+        return _json_reply(
+            200,
+            {
+                "verdicts": [verdict_to_json(v) for v in verdicts],
+                "mode": self.serving_mode(),
+            },
+        )
+
+    def _breaker_open_bulk_reply(self, reqs) -> tuple[int, bytes, dict]:
+        if self.config.failure_policy == FAILURE_POLICY_ALLOW:
+            self.count_failopen(len(reqs))
+            allow = Verdict(interrupted=False, status=200, rule_id=None)
+            return _json_reply(
+                200,
+                {
+                    "verdicts": [verdict_to_json(allow) for _ in reqs],
+                    "mode": "fail-open",
+                },
+            )
+        return _json_reply(
+            503, {"error": "WAF unavailable (fail-closed: circuit breaker open)"}
+        )
+
+    def _admit_device(self, n: int = 1) -> None:
         """Queue admission control: shed (429) instead of growing an
-        unbounded batcher backlog."""
+        unbounded batcher backlog. ``n`` is how many requests the caller
+        is about to submit (a whole ingest window sheds as one unit, but
+        the cko_shed_total counter stays per-request)."""
         budget = self.config.queue_budget
         if budget is None or budget < 0:
             return
         pending = self.batcher.pending()
         if pending > budget:
-            self._m_shed.inc()
+            self._m_shed.inc(n)
             raise Overloaded(
                 f"batcher backlog {pending} over budget {budget}",
                 retry_after_s=self.config.shed_retry_after_s,
@@ -1019,39 +1104,45 @@ class TpuEngineSidecar:
             return None
         self.degraded.record_device_success()
         verdicts, blob = out
+        self.record_window(engine, blob, verdicts)
+        return [verdict_to_json(v) for v in verdicts]
+
+    def record_window(self, engine, blob: bytes, verdicts: list[Verdict]) -> None:
+        """Batch accounting for blob-backed windows (bulk fast path and
+        async-ingest filter windows): metrics in two increments, audit
+        posture IDENTICAL to the per-request ``record_verdict`` path
+        (ADVICE r3) — ``AuditLogger``'s relevant_only setting decides,
+        with request lines recovered from the native request blob."""
         n_deny = sum(1 for v in verdicts if v.interrupted)
         self._m_requests.inc(n_deny, action="deny")
         self._m_requests.inc(len(verdicts) - n_deny, action="allow")
-        if self.audit is not None:
-            from ..native import blob_request_lines
+        if self.audit is None:
+            return
+        from ..native import blob_request_lines
 
-            if self.audit.relevant_only:
-                wanted = {
-                    i
-                    for i, v in enumerate(verdicts)
-                    if v.interrupted or v.matched_ids
-                }
-            else:
-                wanted = set(range(len(verdicts)))
-            if wanted:
-                lines = blob_request_lines(blob, wanted)
-                meta = engine.rule_meta
-                for i in sorted(wanted):
-                    method, uri, version, remote = lines.get(i, ("?", "?", "?", ""))
-                    v = verdicts[i]
-                    self.audit.log(
-                        AuditRecord(
-                            request_line=f"{method} {uri} {version}",
-                            client=remote,
-                            status=v.status,
-                            interrupted=v.interrupted,
-                            matched=[
-                                meta.get(rid, {"id": rid}) for rid in v.matched_ids
-                            ],
-                            tenant=self.tenants.default_tenant or "",
-                        )
-                    )
-        return [verdict_to_json(v) for v in verdicts]
+        if self.audit.relevant_only:
+            wanted = {
+                i for i, v in enumerate(verdicts) if v.interrupted or v.matched_ids
+            }
+        else:
+            wanted = set(range(len(verdicts)))
+        if not wanted:
+            return
+        lines = blob_request_lines(blob, wanted)
+        meta = engine.rule_meta if engine is not None else {}
+        for i in sorted(wanted):
+            method, uri, version, remote = lines.get(i, ("?", "?", "?", ""))
+            v = verdicts[i]
+            self.audit.log(
+                AuditRecord(
+                    request_line=f"{method} {uri} {version}",
+                    client=remote,
+                    status=v.status,
+                    interrupted=v.interrupted,
+                    matched=[meta.get(rid, {"id": rid}) for rid in v.matched_ids],
+                    tenant=self.tenants.default_tenant or "",
+                )
+            )
 
     def evaluate_many(
         self,
@@ -1256,6 +1347,11 @@ class TpuEngineSidecar:
             "rollbacks_forced": self.tenants.total_rollbacks_forced,
             "cko_rules_skipped_total": self._compile_report_len("skipped"),
             "cko_rules_approximated_total": self._compile_report_len("approximated"),
+            "frontend": (
+                self._frontend.stats()
+                if self._frontend is not None
+                else {"mode": "threaded"}
+            ),
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -1270,13 +1366,17 @@ class TpuEngineSidecar:
             engine = self.tenants.engine_for(key)
             if engine is not None:
                 self.degraded.ensure_probe(engine)
-        self._serve_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="sidecar-http", daemon=True
-        )
-        self._serve_thread.start()
+        if self._frontend is not None:
+            self._frontend.start()
+        else:
+            self._serve_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="sidecar-http", daemon=True
+            )
+            self._serve_thread.start()
         log.info(
             "tpu-engine sidecar started",
             addr=f":{self.port}",
+            frontend=self.config.frontend,
             instance=self.config.instance_key,
             failurePolicy=self.config.failure_policy,
             maxBatch=self.config.max_batch_size,
@@ -1285,10 +1385,13 @@ class TpuEngineSidecar:
     def stop(self) -> None:
         # Stop accepting connections first, then drain the batcher (which
         # fails any still-queued futures fast), then the reloader.
-        self._httpd.shutdown()
-        if self._serve_thread:
-            self._serve_thread.join(timeout=10)
-        self._httpd.server_close()
+        if self._frontend is not None:
+            self._frontend.stop()
+        else:
+            self._httpd.shutdown()
+            if self._serve_thread:
+                self._serve_thread.join(timeout=10)
+            self._httpd.server_close()
         self.degraded.stop()
         if self.rollout is not None:
             self.rollout.stop()

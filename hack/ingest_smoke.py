@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Async ingest frontend smoke (ISSUE 10 CI satellite).
+
+Drives the SAME request stream over real sockets through two
+``TpuEngineSidecar`` instances sharing one ``WafEngine`` — once through
+the legacy ``ThreadingHTTPServer`` frontend and once through the
+asyncio-native ingest loop (docs/SERVING.md) — with a keep-alive,
+pipelined multi-connection client, and asserts:
+
+1. async end-to-end throughput >= RATIO x the threaded frontend
+   (default 2.0: the async loop parses once on one core and ships whole
+   windows as zero-copy blobs, where the threaded path pays a Python
+   thread + HttpRequest materialization per request), and
+2. the two frontends' verdicts are BIT-IDENTICAL per request
+   (status + x-waf-action + x-waf-rule-id): the frontend is a transport,
+   it must never alter a verdict.
+
+Usage: ingest_smoke.py [--ratio 2.0] [--requests 2400] [--conns 8]
+[--depth 32] (env overrides: INGEST_SMOKE_RATIO / _REQUESTS / _CONNS /
+_DEPTH). Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _request_bytes(req) -> bytes:
+    # Synthetic attack URIs carry raw spaces; a request line must not
+    # (both frontends would 400 + close). Encode like a real client.
+    uri = req.uri.replace(" ", "%20")
+    lines = [f"{req.method} {uri} HTTP/1.1"]
+    for k, v in req.headers:
+        lines.append(f"{k}: {v}")
+    if req.body:
+        lines.append(f"Content-Length: {len(req.body)}")
+    head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+    return head + (req.body or b"")
+
+
+def _read_response(f):
+    status_line = f.readline()
+    if not status_line:
+        raise ConnectionError("server closed connection mid-stream")
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        ln = f.readline()
+        if ln in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = ln.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", 0))
+    if length:
+        f.read(length)
+    return (status, headers.get("x-waf-action"), headers.get("x-waf-rule-id"))
+
+
+def _conn_worker(port, payloads, depth, out, idx):
+    try:
+        verdicts = []
+        s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        try:
+            f = s.makefile("rb")
+            for i in range(0, len(payloads), depth):
+                group = payloads[i : i + depth]
+                s.sendall(b"".join(group))
+                for _ in group:
+                    verdicts.append(_read_response(f))
+        finally:
+            s.close()
+        out[idx] = verdicts
+    except BaseException as err:  # surfaced by _drive in the main thread
+        out[idx] = err
+
+
+def _drive(port, payloads, conns, depth):
+    """Send payloads over `conns` keep-alive connections (pipelined in
+    groups of `depth`); returns (verdicts in request order, wall_s)."""
+    shares = [payloads[i::conns] for i in range(conns)]
+    out = [None] * conns
+    threads = [
+        threading.Thread(target=_conn_worker, args=(port, shares[i], depth, out, i))
+        for i in range(conns)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    for r in out:
+        if isinstance(r, BaseException):
+            raise r
+    # Un-stride back to request order.
+    verdicts = [None] * len(payloads)
+    for i in range(conns):
+        verdicts[i::conns] = out[i]
+    return verdicts, wall
+
+
+def main() -> int:
+    ratio_env = os.environ.get("INGEST_SMOKE_RATIO")
+    ratio = float(ratio_env) if ratio_env else 2.0
+    ratio_explicit = ratio_env is not None
+    n_requests = int(os.environ.get("INGEST_SMOKE_REQUESTS", "2400"))
+    conns = int(os.environ.get("INGEST_SMOKE_CONNS", "8"))
+    depth = int(os.environ.get("INGEST_SMOKE_DEPTH", "32"))
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--ratio":
+            ratio = float(args.pop(0))
+            ratio_explicit = True
+        elif a == "--requests":
+            n_requests = int(args.pop(0))
+        elif a == "--conns":
+            conns = int(args.pop(0))
+        elif a == "--depth":
+            depth = int(args.pop(0))
+    single_core = (os.cpu_count() or 1) <= 1
+    if single_core and not ratio_explicit:
+        # One core = acceptor, batcher, and XLA timeshare: the async
+        # win collapses toward parity. The gate degrades (loudly) to
+        # "no regression + bit-identical verdicts"; CI runners are
+        # multicore and keep the strict 2x bar.
+        ratio = 0.9
+
+    os.environ.setdefault("CKO_VALUE_CACHE_MB", "0")
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.corpus import (
+        synthetic_crs,
+        synthetic_requests,
+    )
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    configure_persistent_cache(os.environ.get("CKO_COMPILE_CACHE_DIR"))
+    eng = WafEngine(synthetic_crs(40, seed=3))
+    payloads = [
+        _request_bytes(r)
+        for r in synthetic_requests(n_requests, attack_ratio=0.2, seed=7)
+    ]
+    warm = payloads[: min(256, len(payloads))]
+
+    results = {}
+    frontend_stats = {}
+    for frontend in ("threaded", "async"):
+        sc = TpuEngineSidecar(
+            SidecarConfig(
+                host="127.0.0.1",
+                port=0,
+                max_batch_size=128,
+                max_batch_delay_ms=2.0,
+                frontend=frontend,
+            ),
+            engine=eng,
+        )
+        sc.start()
+        try:
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline and sc.serving_mode() != "promoted":
+                time.sleep(0.05)
+            _drive(sc.port, warm, conns, depth)  # untimed warm
+            verdicts, wall = _drive(sc.port, payloads, conns, depth)
+            results[frontend] = (verdicts, wall)
+            frontend_stats[frontend] = sc.stats().get("frontend", {})
+        finally:
+            sc.stop()
+
+    t_verdicts, t_wall = results["threaded"]
+    a_verdicts, a_wall = results["async"]
+    identical = a_verdicts == t_verdicts
+    blocked = sum(1 for v in a_verdicts if v[1] == "deny")
+    t_rps = n_requests / max(t_wall, 1e-9)
+    a_rps = n_requests / max(a_wall, 1e-9)
+    speedup = a_rps / max(t_rps, 1e-9)
+    fe = frontend_stats["async"]
+    verdict = {
+        "req_per_s_threaded": round(t_rps, 1),
+        "req_per_s_async": round(a_rps, 1),
+        "speedup": round(speedup, 3),
+        "required": ratio,
+        "requests": n_requests,
+        "conns": conns,
+        "depth": depth,
+        "verdicts_identical": identical,
+        "blocked": blocked,
+        "async_frontend": {
+            "loop": fe.get("loop"),
+            "windows": fe.get("windows"),
+            "parse_s_per_req": round(
+                fe.get("parse_s", 0.0) / max(fe.get("requests_total", 1), 1), 7
+            ),
+            "bytes_total": fe.get("bytes_total"),
+        },
+        "cpus": os.cpu_count(),
+        "single_core_degraded_gate": single_core and not ratio_explicit,
+    }
+    ok = speedup >= ratio and identical and blocked > 0
+    verdict["smoke"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,470 @@
+"""Async ingest frontend tests (docs/SERVING.md).
+
+Covers the contracts the asyncio frontend must preserve over the legacy
+``ThreadingHTTPServer``: keep-alive + pipelined requests answered in
+order, malformed/oversized request handling (400/413 parity), deadline
+and 429 shedding behavior, hot-reload draining mid-connection, and
+bit-identical verdicts threaded-vs-async on the bundled ftw corpus.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+from coraza_kubernetes_operator_tpu.engine import WafEngine
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+
+REPO = Path(__file__).resolve().parent.parent
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+
+EVIL_MONKEY = r"""
+SecRule ARGS|REQUEST_URI "@contains evilmonkey" \
+  "id:3001,phase:2,deny,status:403,t:none,t:urlDecodeUni,msg:'Evil Monkey'"
+"""
+
+TIGER_RULE = r"""
+SecRule ARGS|REQUEST_URI "@contains eviltiger" \
+  "id:3002,phase:2,deny,status:403,t:none,msg:'Evil Tiger'"
+"""
+
+KEY = "default/waf-rules"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WafEngine(BASE + EVIL_MONKEY)
+
+
+def _sidecar(engine=None, frontend="async", **kw) -> TpuEngineSidecar:
+    config = SidecarConfig(
+        host="127.0.0.1",
+        port=0,
+        max_batch_size=kw.pop("max_batch_size", 64),
+        max_batch_delay_ms=kw.pop("max_batch_delay_ms", 1.0),
+        frontend=frontend,
+        **kw,
+    )
+    return TpuEngineSidecar(config, engine=engine)
+
+
+def _wait(predicate, timeout_s=30.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def _http(port, path, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method, data=body,
+        headers=headers or {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=30)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _read_response(f):
+    """Minimal HTTP/1.1 response parser over a buffered socket file —
+    both frontends always send Content-Length."""
+    status_line = f.readline()
+    if not status_line:
+        return None
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        ln = f.readline()
+        if ln in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = ln.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", 0))
+    body = f.read(length) if length else b""
+    return status, headers, body
+
+
+def _raw(port, payload: bytes, n_responses: int = 1, timeout=30):
+    """Send raw bytes on one connection; read back n responses."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    out = []
+    try:
+        s.sendall(payload)
+        f = s.makefile("rb")
+        for _ in range(n_responses):
+            out.append(_read_response(f))
+    finally:
+        s.close()
+    return out
+
+
+# -- keep-alive + pipelining --------------------------------------------------
+
+
+def test_keepalive_pipelined_in_order(engine):
+    sc = _sidecar(engine)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        uris = ["/?a=evilmonkey", "/clean1", "/x?b=evilmonkey", "/clean2",
+                "/y?c=evilmonkey", "/clean3"]
+        payload = b"".join(
+            f"GET {u} HTTP/1.1\r\nHost: t\r\n\r\n".encode() for u in uris
+        )
+        responses = _raw(sc.port, payload, n_responses=len(uris))
+        statuses = [r[0] for r in responses]
+        assert statuses == [403, 200, 403, 200, 403, 200]
+        # All six rode one connection, and the deny replies carry the
+        # rule attribution headers.
+        assert responses[0][1]["x-waf-action"] == "deny"
+        assert responses[0][1]["x-waf-rule-id"] == "3001"
+        assert responses[1][1]["x-waf-action"] == "allow"
+        fe = sc.stats()["frontend"]
+        assert fe["mode"] == "async"
+        assert fe["requests_total"] >= len(uris)
+        assert fe["window_requests"] >= len(uris)
+    finally:
+        sc.stop()
+
+
+def test_keepalive_sequential_requests_one_connection(engine):
+    sc = _sidecar(engine)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        s = socket.create_connection(("127.0.0.1", sc.port), timeout=30)
+        f = s.makefile("rb")
+        try:
+            for uri, want in (("/?q=evilmonkey", 403), ("/ok", 200), ("/ok2", 200)):
+                s.sendall(f"GET {uri} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+                status, _, _ = _read_response(f)
+                assert status == want
+        finally:
+            s.close()
+        assert sc.stats()["frontend"]["connections_total"] >= 1
+    finally:
+        sc.stop()
+
+
+# -- malformed / oversized ----------------------------------------------------
+
+
+def test_malformed_request_line_rejected(engine):
+    # Both frontends refuse a garbage request line with a 400. The
+    # threaded path answers in HTTP/0.9 style (bare HTML error body, a
+    # BaseHTTPRequestHandler quirk for version-less request lines), so
+    # only the async reply is asserted as a strict HTTP/1.1 400.
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend=frontend)
+        sc.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", sc.port), timeout=30)
+            try:
+                s.sendall(b"GARBAGE\r\n\r\n")
+                chunks = []
+                while True:
+                    data = s.recv(65536)
+                    if not data:
+                        break
+                    chunks.append(data)
+            finally:
+                s.close()
+            raw = b"".join(chunks)
+            assert b"400" in raw, frontend
+            if frontend == "async":
+                assert raw.startswith(b"HTTP/1.1 400")
+        finally:
+            sc.stop()
+
+
+def test_unknown_method_501_parity(engine):
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend=frontend)
+        sc.start()
+        try:
+            (resp,) = _raw(sc.port, b"GARBAGE / HTTP/1.1\r\nHost: t\r\n\r\n", 1)
+            assert resp is not None, frontend
+            assert resp[0] == 501, frontend
+        finally:
+            sc.stop()
+
+
+def test_oversized_head_rejected(engine):
+    sc = _sidecar(engine)
+    sc.start()
+    try:
+        junk = b"X-Filler: " + b"a" * 70000 + b"\r\n"
+        (resp,) = _raw(sc.port, b"GET / HTTP/1.1\r\n" + junk + b"\r\n", 1)
+        assert resp is not None and resp[0] == 400
+    finally:
+        sc.stop()
+
+
+def test_bulk_invalid_payload_400_parity(engine):
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend=frontend)
+        sc.start()
+        try:
+            status, _, body = _http(
+                sc.port, "/waf/v1/evaluate", method="POST", body=b"not json"
+            )
+            assert status == 400, frontend
+            assert b"invalid request payload" in body, frontend
+        finally:
+            sc.stop()
+
+
+def test_body_limit_reject_413_parity():
+    """SecRequestBodyLimitAction Reject must produce the identical 413
+    deny on both frontends — the async blob path keeps over-limit rows
+    in the tensorized batch and overrides their verdicts after decode,
+    the threaded path excludes the rows before dispatch."""
+    rules = (
+        BASE
+        + "SecRequestBodyLimit 64\nSecRequestBodyLimitAction Reject\n"
+        + EVIL_MONKEY
+    )
+    engine = WafEngine(rules)
+    results = {}
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend=frontend)
+        sc.start()
+        try:
+            assert _wait(sc.ready)
+            assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
+            over = _http(sc.port, "/submit", method="POST", body=b"x" * 200)
+            under_evil = _http(
+                sc.port, "/submit", method="POST", body=b"pet=evilmonkey",
+                headers={"Content-Type": "application/x-www-form-urlencoded"},
+            )
+            clean = _http(sc.port, "/submit", method="POST", body=b"pet=dog",
+                          headers={"Content-Type": "application/x-www-form-urlencoded"})
+            results[frontend] = [
+                (s, h.get("x-waf-action"), h.get("x-waf-rule-id"), b)
+                for s, h, b in (over, under_evil, clean)
+            ]
+        finally:
+            sc.stop()
+    assert results["async"] == results["threaded"]
+    assert results["async"][0][0] == 413
+    assert results["async"][1][0] == 403
+    assert results["async"][2][0] == 200
+
+
+# -- deadline + shedding ------------------------------------------------------
+
+
+def test_deadline_header_routes_python_path(engine):
+    sc = _sidecar(engine)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        status, headers, _ = _http(
+            sc.port, "/?pet=evilmonkey", headers={"X-CKO-Deadline-Ms": "2000"}
+        )
+        assert status == 403
+        assert headers["x-waf-action"] == "deny"
+        status, headers, _ = _http(
+            sc.port, "/clean", headers={"X-CKO-Deadline-Ms": "2000"}
+        )
+        assert status == 200
+        assert sc.stats()["frontend"]["python_path_requests"] >= 2
+    finally:
+        sc.stop()
+
+
+def test_window_shedding_429(engine):
+    sc = _sidecar(engine, queue_budget=8, shed_retry_after_s=2.0)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
+        sc.batcher.pending = lambda: 100  # simulated backlog over budget
+        status, headers, body = _http(sc.port, "/?pet=evilmonkey")
+        assert status == 429
+        assert headers["Retry-After"] == "2"
+        assert headers["x-waf-action"] == "shed"
+        assert b"overloaded" in body
+        status, _, _ = _http(sc.port, "/clean")
+        assert status == 429
+        assert sc.stats()["shed_total"] >= 2
+        # Liveness endpoints answer even while the prepare queue sheds.
+        status, _, _ = _http(sc.port, "/waf/v1/healthz")
+        assert status == 200
+        status, _, _ = _http(sc.port, "/waf/v1/readyz")
+        assert status == 200
+    finally:
+        sc.stop()
+
+
+# -- control endpoints --------------------------------------------------------
+
+
+def test_control_endpoints_on_async_loop(engine):
+    sc = _sidecar(engine)
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        status, _, body = _http(sc.port, "/waf/v1/healthz")
+        assert (status, body) == (200, b"ok\n")
+        status, _, body = _http(sc.port, "/waf/v1/readyz")
+        assert status == 200 and body.startswith(b"ok mode=")
+        status, _, body = _http(sc.port, "/waf/v1/stats")
+        assert status == 200
+        stats = json.loads(body)
+        assert stats["frontend"]["mode"] == "async"
+        assert stats["frontend"]["loop"] in ("asyncio", "uvloop")
+        status, _, body = _http(sc.port, "/waf/v1/metrics")
+        assert status == 200
+        assert b"cko_ingest_connections" in body
+        assert b"cko_ingest_parse_s" in body
+        assert b"cko_ingest_bytes_total" in body
+        status, _, _ = _http(sc.port, "/waf/v1/nope")
+        assert status == 404
+    finally:
+        sc.stop()
+
+
+def test_metrics_auth_enforced_on_async(engine):
+    sc = _sidecar(engine, metrics_auth_token="sekrit")
+    sc.start()
+    try:
+        status, _, _ = _http(sc.port, "/waf/v1/metrics")
+        assert status == 401
+        status, _, _ = _http(
+            sc.port, "/waf/v1/metrics",
+            headers={"Authorization": "Bearer sekrit"},
+        )
+        assert status == 200
+    finally:
+        sc.stop()
+
+
+# -- hot reload mid-connection ------------------------------------------------
+
+
+def test_hot_reload_drains_mid_connection():
+    cache = RuleSetCache()
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    cache.put(KEY, BASE + EVIL_MONKEY)
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            cache_base_url=f"http://127.0.0.1:{srv.port}",
+            instance_key=KEY,
+            poll_interval_s=0.05,
+            host="127.0.0.1",
+            port=0,
+            max_batch_delay_ms=1.0,
+        )
+    )
+    sc.start()
+    try:
+        assert _wait(sc.ready)
+        s = socket.create_connection(("127.0.0.1", sc.port), timeout=30)
+        f = s.makefile("rb")
+        try:
+            # Old ruleset serves this keep-alive connection...
+            s.sendall(b"GET /?pet=eviltiger HTTP/1.1\r\nHost: t\r\n\r\n")
+            status, _, _ = _read_response(f)
+            assert status == 200
+            # ...the ruleset hot-swaps underneath it...
+            cache.put(KEY, BASE + EVIL_MONKEY + TIGER_RULE)
+            assert _wait(lambda: sc.reloader.reloads >= 2, timeout_s=30)
+            # ...and the SAME connection serves the new ruleset without
+            # reconnecting: in-flight windows drained, new windows route
+            # to the swapped engine.
+            s.sendall(b"GET /?pet=eviltiger HTTP/1.1\r\nHost: t\r\n\r\n")
+            status, headers, _ = _read_response(f)
+            assert status == 403
+            assert headers["x-waf-rule-id"] == "3002"
+            s.sendall(b"GET /?pet=evilmonkey HTTP/1.1\r\nHost: t\r\n\r\n")
+            status, _, _ = _read_response(f)
+            assert status == 403
+        finally:
+            s.close()
+    finally:
+        sc.stop()
+        srv.stop()
+
+
+# -- ftw corpus verdict parity ------------------------------------------------
+
+
+def _corpus_stage_requests():
+    """Every runnable request in the bundled ftw corpus, as raw HTTP/1.1
+    bytes (identical bytes go to both frontends)."""
+    from coraza_kubernetes_operator_tpu.ftw import load_tests
+
+    out = []
+    for test in load_tests(REPO / "ftw" / "tests"):
+        for stage in test.stages:
+            if stage.response_status is not None:
+                continue  # response-injection stages can't replay over HTTP
+            declared = {k.lower(): v for k, v in stage.headers}
+            cl = declared.get("content-length")
+            if cl is not None and (not cl.isdigit() or int(cl) != len(stage.data)):
+                continue  # intentionally broken framing would desync reads
+            lines = [f"{stage.method} {stage.uri} HTTP/1.1"]
+            if "host" not in declared:
+                lines.append("Host: parity.test")
+            for k, v in stage.headers:
+                lines.append(f"{k}: {v}")
+            if stage.data and cl is None:
+                lines.append(f"Content-Length: {len(stage.data)}")
+            lines.append("Connection: close")
+            raw = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+            out.append((test.title, raw + stage.data))
+    return out
+
+
+@pytest.mark.slow
+def test_ftw_corpus_verdict_parity_threaded_vs_async():
+    rules = (REPO / "ftw" / "rules" / "base.conf").read_text() + (
+        REPO / "ftw" / "rules" / "crs-mini.conf"
+    ).read_text()
+    engine = WafEngine(rules)
+    stages = _corpus_stage_requests()
+    assert len(stages) >= 10
+    verdicts = {}
+    for frontend in ("threaded", "async"):
+        sc = _sidecar(engine, frontend=frontend)
+        sc.start()
+        try:
+            assert _wait(sc.ready)
+            assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=120)
+            got = []
+            for title, raw in stages:
+                (resp,) = _raw(sc.port, raw, 1)
+                assert resp is not None, (frontend, title)
+                status, headers, body = resp
+                got.append(
+                    (
+                        title,
+                        status,
+                        headers.get("x-waf-action"),
+                        headers.get("x-waf-rule-id"),
+                        body,
+                    )
+                )
+            verdicts[frontend] = got
+        finally:
+            sc.stop()
+    assert verdicts["async"] == verdicts["threaded"]
+    # The corpus must actually exercise both outcomes.
+    actions = {v[2] for v in verdicts["async"]}
+    assert "deny" in actions and "allow" in actions
