@@ -59,8 +59,12 @@ pipeline.smoke:  ## Host/device overlap gate: pipelined >= 1.2x sync, verdicts i
 ingest.smoke:  ## Async frontend gate: async >= 2x threaded req/s, verdicts identical.
 	$(PYTHON) hack/ingest_smoke.py
 
+.PHONY: ingest.fuzz
+ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontends, zero leaks.
+	$(PYTHON) hack/ingest_fuzz.py
+
 .PHONY: chaos.smoke
-chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage.
+chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm.
 	$(PYTHON) hack/chaos_smoke.py
 
 .PHONY: compile.smoke

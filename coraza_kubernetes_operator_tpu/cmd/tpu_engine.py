@@ -125,6 +125,60 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         help="cooldown before a half-open device re-probe",
     )
     p.add_argument(
+        "--drain-timeout-seconds",
+        type=float,
+        default=2.0,
+        help="shutdown drain budget: seconds to wait for in-flight ingest"
+        " windows before force-closing connections (counted in"
+        " cko_ingest_aborted_total)",
+    )
+    p.add_argument(
+        "--max-connections",
+        type=int,
+        default=None,
+        help="global concurrent-connection cap, 503 past it (default"
+        " $CKO_INGRESS_MAX_CONNS or 1024; negative disables)",
+    )
+    p.add_argument(
+        "--header-timeout-seconds",
+        type=float,
+        default=None,
+        help="total deadline from first head byte to complete request head,"
+        " 408 past it — slowloris defense (default"
+        " $CKO_INGRESS_HEADER_TIMEOUT_S or 10; 0 disables)",
+    )
+    p.add_argument(
+        "--idle-timeout-seconds",
+        type=float,
+        default=None,
+        help="keep-alive idle timeout before a quiet connection closes"
+        " (default $CKO_INGRESS_IDLE_TIMEOUT_S or 75; 0 disables)",
+    )
+    p.add_argument(
+        "--body-timeout-seconds",
+        type=float,
+        default=None,
+        help="total deadline for reading a request body, 408 past it"
+        " (default $CKO_INGRESS_BODY_TIMEOUT_S or 30; 0 disables)",
+    )
+    p.add_argument(
+        "--max-body-bytes",
+        type=int,
+        default=None,
+        help="request-body ceiling, 413 during the read — never buffered"
+        " (default $CKO_INGRESS_MAX_BODY_BYTES or 10485760; negative"
+        " disables)",
+    )
+    p.add_argument(
+        "--ingress-memory-budget-bytes",
+        type=int,
+        default=None,
+        help="global in-flight request-byte budget; new work sheds 429"
+        " past it while control endpoints stay live (default"
+        " $CKO_INGRESS_MEMORY_BUDGET_BYTES or 268435456; negative"
+        " disables)",
+    )
+    p.add_argument(
         "--compile-cache-dir",
         default=None,
         help="persistent XLA compilation cache directory (default"
@@ -197,6 +251,13 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         compile_budget_s=args.compile_budget_seconds,
         shadow_promote_windows=args.shadow_promote_windows,
         shadow_sample_rate=args.shadow_sample_rate,
+        drain_timeout_s=args.drain_timeout_seconds,
+        max_connections=args.max_connections,
+        header_timeout_s=args.header_timeout_seconds,
+        idle_timeout_s=args.idle_timeout_seconds,
+        body_timeout_s=args.body_timeout_seconds,
+        max_body_bytes=args.max_body_bytes,
+        ingress_memory_budget_bytes=args.ingress_memory_budget_bytes,
     )
 
 

@@ -10,6 +10,7 @@ contract the WASM plugin uses (uuid change ⇒ recompile ⇒ swap tables).
 
 from .batcher import MicroBatcher
 from .degraded import CircuitBreaker, DegradedModeManager
+from .governor import IngressGovernor
 from .reloader import RuleReloader
 from .rollout import RolloutConfig, RolloutManager
 from .server import SidecarConfig, TpuEngineSidecar
@@ -17,6 +18,7 @@ from .server import SidecarConfig, TpuEngineSidecar
 __all__ = [
     "CircuitBreaker",
     "DegradedModeManager",
+    "IngressGovernor",
     "MicroBatcher",
     "RolloutConfig",
     "RolloutManager",

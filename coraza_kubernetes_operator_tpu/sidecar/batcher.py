@@ -226,6 +226,10 @@ class MicroBatcher:
         # admission-control signal must count them (a blob window is one
         # queue item but n_req requests of backlog).
         self._blob_pending = 0
+        # Bytes of those queued blob windows — the ingress byte ledger
+        # (sidecar.governor) reports them so assembled-but-undispatched
+        # windows are visible in the memory-backpressure picture.
+        self._blob_pending_bytes = 0
 
     @property
     def busy(self) -> bool:
@@ -297,6 +301,7 @@ class MicroBatcher:
             if isinstance(item, _BlobWindow):
                 with self._inflight_lock:
                     self._blob_pending -= item.n_req
+                    self._blob_pending_bytes -= len(item.blob)
                 _resolve(item.fut.set_exception, err)
             elif item is not None:
                 _resolve(item[2].set_exception, err)
@@ -317,6 +322,7 @@ class MicroBatcher:
         fut: Future = Future()
         with self._inflight_lock:
             self._blob_pending += n_req
+            self._blob_pending_bytes += len(blob)
         self._queue.put(_BlobWindow(blob=blob, n_req=n_req, fut=fut))
         return fut
 
@@ -329,6 +335,12 @@ class MicroBatcher:
         # requests are already in blob_n, so subtracting nothing keeps
         # the signal conservative (over-counts by the window count).
         return self._queue.qsize() + blob_n
+
+    def pending_bytes(self) -> int:
+        """Bytes of blob windows queued but not yet dispatched (the
+        stats/ledger view of assembled-window memory)."""
+        with self._inflight_lock:
+            return self._blob_pending_bytes
 
     def evaluate(
         self, request: HttpRequest, timeout_s: float = 30.0, tenant: str | None = None
@@ -349,6 +361,7 @@ class MicroBatcher:
                 if isinstance(item, _BlobWindow):
                     with self._inflight_lock:
                         self._blob_pending -= item.n_req
+                        self._blob_pending_bytes -= len(item.blob)
                     _resolve(item.fut.set_exception, err)
                 else:
                     _resolve(item[2].set_exception, err)
@@ -360,6 +373,7 @@ class MicroBatcher:
                     # Pre-assembled window: dispatch as-is, never coalesce.
                     with self._inflight_lock:
                         self._blob_pending -= item.n_req
+                        self._blob_pending_bytes -= len(item.blob)
                     self._dispatch_or_fail(item)
                     continue
                 window: list[tuple[HttpRequest, str | None, Future]] = [item]

@@ -26,6 +26,16 @@ stdlib event loop otherwise):
   inline on the event loop; stats/metrics/rollback run on a dedicated
   small control pool separate from the evaluation pool, so a saturated
   prepare queue cannot starve probes.
+- **Ingress governance** (docs/SERVING.md "Overload & limits"): every
+  byte-handling path is bounded by the shared :class:`IngressGovernor`
+  — a global connection cap (503), header/body read deadlines (408,
+  slowloris defense), a streaming body ceiling that answers 413
+  *before/while* reading instead of after buffering, an in-flight byte
+  ledger that sheds with 429 while control endpoints stay exempt, and
+  write-side backpressure that disconnects readers too slow to drain
+  their pipelined responses. One poisoned connection can never kill the
+  acceptor loop: reader, writer, and window dispatch are individually
+  exception-contained and counted.
 
 Degraded-mode contracts are preserved window-at-a-time: breaker-open
 and engine-unavailable windows answer per failurePolicy, queue-budget
@@ -59,8 +69,14 @@ API_PREFIX = "/waf/v1/"
 # whole head — past it the request answers 400 and the connection closes.
 MAX_HEAD_BYTES = 65536
 # Per-connection cap on pipelined responses not yet written back; the
-# reader pauses (TCP backpressure) once a client is this far ahead.
+# reader blocks on a semaphore (felt as TCP backpressure) once a client
+# is this far ahead of the writer.
 MAX_PIPELINED = 256
+# Writer-side drain threshold: with a pipelining client the writer only
+# awaits drain() when the transport buffer is already this deep (or the
+# queue is empty), so slow readers are detected without serializing the
+# fast path on every response.
+_WRITE_HIGH_WATER = 1 << 20
 
 _METHODS_WITH_BODY = {b"POST", b"PUT", b"PATCH", b"DELETE"}
 _KNOWN_METHODS = {b"GET"} | _METHODS_WITH_BODY
@@ -74,7 +90,147 @@ _SPECIAL = {
     b"x-waf-tenant",
     b"authorization",
 }
+# Probe/operator targets that must stay answerable under memory
+# pressure: the byte-ledger shed never applies to them.
+_CONTROL_TARGETS = {
+    b"/waf/v1/healthz",
+    b"/waf/v1/readyz",
+    b"/waf/v1/stats",
+    b"/waf/v1/metrics",
+    b"/waf/v1/rollback",
+}
 _pack = struct.pack
+
+
+class _ReadTimeout(Exception):
+    """A per-connection read deadline expired mid-request (→ 408)."""
+
+
+class _Truncated(Exception):
+    """The peer closed (or reset) before the framed bytes arrived."""
+
+    def __init__(self, partial: bytes = b""):
+        super().__init__("truncated read")
+        self.partial = partial
+
+
+class _BodyTooLarge(Exception):
+    """Streaming body grew past the governor ceiling (→ 413)."""
+
+
+class _ConnReader:
+    """Buffered, deadline-aware reader over an ``asyncio.StreamReader``.
+
+    ``readuntil``/``readexactly`` cannot distinguish an idle keep-alive
+    connection from a slowloris trickling header bytes, and offer no way
+    to recover partial bytes on timeout. This wrapper owns the buffer,
+    so every read primitive can carry a deadline and report exactly what
+    arrived.
+    """
+
+    __slots__ = ("_r", "_loop", "buf", "eof")
+    CHUNK = 65536
+
+    def __init__(self, reader: asyncio.StreamReader, loop) -> None:
+        self._r = reader
+        self._loop = loop
+        self.buf = bytearray()
+        self.eof = False
+
+    async def _fill(self, timeout: float | None) -> bool:
+        """Pull one chunk into the buffer; False on EOF; raises
+        ``asyncio.TimeoutError`` when the deadline has passed."""
+        if self.eof:
+            return False
+        if timeout is not None and timeout <= 0:
+            raise asyncio.TimeoutError
+        if timeout is not None:
+            data = await asyncio.wait_for(self._r.read(self.CHUNK), timeout)
+        else:
+            data = await self._r.read(self.CHUNK)
+        if not data:
+            self.eof = True
+            return False
+        self.buf += data
+        return True
+
+    async def read_head(self, idle_timeout: float, header_timeout: float, max_bytes: int):
+        """Read one request head (through ``\\r\\n\\r\\n``).
+
+        Returns ``(head, None)`` or ``(None, err)`` with err in
+        ``{"idle", "timeout", "overrun", "closed", "partial"}``. The
+        idle timeout applies while nothing has arrived (a quiet
+        keep-alive connection — closed silently); the header timeout is
+        a *total* deadline from the first head byte, which is what
+        defeats a slowloris trickling one byte per poll.
+        """
+        started: float | None = None
+        while True:
+            i = self.buf.find(b"\r\n\r\n")
+            if i >= 0:
+                if i + 4 > max_bytes:
+                    return None, "overrun"
+                head = bytes(self.buf[: i + 4])
+                del self.buf[: i + 4]
+                return head, None
+            if len(self.buf) > max_bytes:
+                return None, "overrun"
+            empty = not bytes(self.buf).strip()
+            if not empty and started is None:
+                started = self._loop.time()
+            if empty:
+                timeout = idle_timeout if idle_timeout > 0 else None
+            elif header_timeout > 0:
+                timeout = header_timeout - (self._loop.time() - started)
+            else:
+                timeout = None
+            try:
+                more = await self._fill(timeout)
+            except asyncio.TimeoutError:
+                return None, ("idle" if empty else "timeout")
+            except (ConnectionError, OSError):
+                more = False
+            if not more:
+                return None, ("partial" if bytes(self.buf).strip() else "closed")
+
+    async def read_exactly(self, n: int, deadline: float | None) -> bytes:
+        """Read exactly ``n`` bytes by an absolute loop-time deadline.
+        Raises ``_ReadTimeout`` or ``_Truncated`` (carrying the partial
+        bytes, so callers can evaluate what arrived)."""
+        while len(self.buf) < n:
+            timeout = None if deadline is None else deadline - self._loop.time()
+            try:
+                more = await self._fill(timeout)
+            except asyncio.TimeoutError:
+                raise _ReadTimeout from None
+            except (ConnectionError, OSError):
+                more = False
+            if not more:
+                partial = bytes(self.buf)
+                self.buf = bytearray()
+                raise _Truncated(partial)
+        out = bytes(self.buf[:n])
+        del self.buf[:n]
+        return out
+
+    async def read_line(self, deadline: float | None, limit: int = 65536) -> bytes:
+        while True:
+            i = self.buf.find(b"\n")
+            if i >= 0:
+                line = bytes(self.buf[: i + 1])
+                del self.buf[: i + 1]
+                return line
+            if len(self.buf) > limit:
+                raise _Truncated(bytes(self.buf))
+            timeout = None if deadline is None else deadline - self._loop.time()
+            try:
+                more = await self._fill(timeout)
+            except asyncio.TimeoutError:
+                raise _ReadTimeout from None
+            except (ConnectionError, OSError):
+                more = False
+            if not more:
+                raise _Truncated(b"")
 
 
 def _parse_head(head: bytes):
@@ -247,16 +403,23 @@ class AsyncIngestFrontend:
         except RuntimeError:
             pass
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            drain_s = getattr(self.sidecar.config, "drain_timeout_s", 2.0)
+            self._thread.join(timeout=max(10.0, drain_s + 5.0))
         self._eval_pool.shutdown(wait=False)
         self._ctl_pool.shutdown(wait=False)
 
     async def _drain(self) -> None:
         """Bounded shutdown drain: dispatched windows get a moment to
-        resolve so queued clients see answers instead of resets."""
-        deadline = self._loop.time() + 2.0
+        resolve so queued clients see answers instead of resets. The
+        budget is ``SidecarConfig.drain_timeout_s``; connections still
+        open when it expires are force-closed and counted in
+        ``cko_ingest_aborted_total``."""
+        drain_s = getattr(self.sidecar.config, "drain_timeout_s", 2.0)
+        deadline = self._loop.time() + max(0.0, drain_s)
         while self._inflight_windows > 0 and self._loop.time() < deadline:
             await asyncio.sleep(0.02)
+        if self.connections > 0:
+            self.sidecar.governor.count("aborted_total", self.connections)
         current = asyncio.current_task(self._loop)
         tasks = [t for t in asyncio.all_tasks(self._loop) if t is not current]
         for task in tasks:
@@ -274,15 +437,48 @@ class AsyncIngestFrontend:
     # -- connection handling -------------------------------------------------
 
     async def _handle_conn(self, reader, writer) -> None:
+        gov = self.sidecar.governor
+        if not gov.try_admit_conn():
+            # Over the global cap: answer 503 and close without ever
+            # entering the read loop, so a connection storm cannot grow
+            # per-connection state.
+            try:
+                writer.write(
+                    self._render(
+                        503,
+                        b"too many connections\n",
+                        {"Content-Type": "text/plain"},
+                        False,
+                    )
+                )
+                await writer.drain()
+            except Exception:
+                pass
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+            return
         self.connections += 1
         self.connections_total += 1
         queue: asyncio.Queue = asyncio.Queue()
-        rtask = asyncio.ensure_future(self._read_requests(reader, writer, queue))
-        # Reliable writer wakeup on EOF/parse-exit: the queue is unbounded
-        # (reader throttles on qsize) so the sentinel can never be lost.
+        # Bounded-queue semantics (asyncio.Queue(MAX_PIPELINED)) without
+        # a blocking put: the reader acquires one slot per request and
+        # the writer releases it once the response is on the wire, so
+        # the EOF sentinel below can still use put_nowait unconditionally.
+        sem = asyncio.Semaphore(MAX_PIPELINED)
+        rtask = asyncio.ensure_future(self._read_guarded(reader, writer, queue, sem))
+        # Reliable writer wakeup on EOF/parse-exit: the sentinel put can
+        # never be lost because it bypasses the slot semaphore.
         rtask.add_done_callback(lambda _t: queue.put_nowait(None))
         try:
-            await self._write_responses(queue, writer)
+            await self._write_responses(queue, writer, sem)
+        except asyncio.CancelledError:
+            raise
+        except Exception as err:
+            gov.count("conn_errors_total")
+            log.error("ingest writer failed", err)
         finally:
             rtask.cancel()
             try:
@@ -294,24 +490,54 @@ class AsyncIngestFrontend:
                 await writer.wait_closed()
             except Exception:
                 pass
+            # Responses the writer never consumed still hold ledger
+            # bytes — return them before the connection disappears.
+            while not queue.empty():
+                item = queue.get_nowait()
+                if item is not None:
+                    gov.discharge(item[2])
             self.connections -= 1
+            gov.release_conn()
 
-    async def _read_requests(self, reader, writer, queue) -> None:
+    async def _read_guarded(self, reader, writer, queue, sem) -> None:
+        """Per-connection exception containment: a poisoned connection
+        (parser bug, codec edge case) is counted and closed — it can
+        never propagate into the acceptor loop."""
+        try:
+            await self._read_requests(reader, writer, queue, sem)
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionError, OSError):
+            pass
+        except Exception as err:
+            self.sidecar.governor.count("conn_errors_total")
+            log.error("ingest reader failed", err)
+
+    async def _read_requests(self, reader, writer, queue, sem) -> None:
+        gov = self.sidecar.governor
         peer = writer.get_extra_info("peername")
         remote_b = (peer[0] if isinstance(peer, tuple) and peer else "").encode(
             "latin-1", "replace"
         )
+        cr = _ConnReader(reader, self._loop)
         while True:
-            try:
-                head = await reader.readuntil(b"\r\n\r\n")
-            except asyncio.IncompleteReadError as err:
-                if err.partial.strip():
+            # One reply-queue slot per request — blocking here is the
+            # pipelining backpressure (client feels TCP backpressure).
+            await sem.acquire()
+            head, herr = await cr.read_head(
+                gov.idle_timeout_s, gov.header_timeout_s, MAX_HEAD_BYTES
+            )
+            if herr is not None:
+                if herr == "overrun":
+                    self._put_static(queue, 400, b"request head too large\n")
+                elif herr == "partial":
                     self._put_static(queue, 400, b"bad request\n")
-                return
-            except asyncio.LimitOverrunError:
-                self._put_static(queue, 400, b"request head too large\n")
-                return
-            except (ConnectionError, OSError):
+                elif herr == "timeout":
+                    # Slowloris: a partial head older than the header
+                    # deadline. Answer 408 and close.
+                    gov.count("deadline_closed_total")
+                    self._put_static(queue, 408, b"request header timeout\n")
+                # "idle" (quiet keep-alive) and "closed" end silently.
                 return
             t0 = _time.perf_counter()
             parsed = _parse_head(head)
@@ -320,14 +546,47 @@ class AsyncIngestFrontend:
                 self._put_static(queue, 400, b"bad request\n")
                 return
             method, target, version, pairs, special = parsed
+            if not version.startswith(b"HTTP/1."):
+                # BaseHTTPRequestHandler taxonomy: an unparsable version
+                # token is a 400 ("Bad request version"); a well-formed
+                # version that simply isn't 1.x gets the 505. (HTTP/0.9
+                # is not served here — the threaded escape hatch keeps
+                # the stdlib's bare-body 0.9 reply for that museum piece.)
+                vparts = version[5:].split(b".") if version.startswith(b"HTTP/") else []
+                if len(vparts) != 2 or not all(
+                    p.isdigit() and 0 < len(p) <= 10 for p in vparts
+                ):
+                    self._put_static(queue, 400, b"bad request version\n")
+                else:
+                    self._put_static(queue, 505, b"http version not supported\n")
+                return
             if method not in _KNOWN_METHODS:
                 self._put_static(queue, 501, b"unsupported method\n")
                 return
+            is_ctl = target.split(b"?", 1)[0] in _CONTROL_TARGETS
             # -- body ---------------------------------------------------------
             body = b""
             close_after = False
+            body_deadline = (
+                self._loop.time() + gov.body_timeout_s if gov.body_timeout_s > 0 else None
+            )
             if b"chunked" in special.get(b"transfer-encoding", b"").lower():
-                body, malformed = await self._read_chunked(reader)
+                if not is_ctl and not gov.can_admit(len(head)):
+                    gov.count("shed_total")
+                    self._put_shed(queue)
+                    return
+                try:
+                    body, malformed = await self._read_chunked(
+                        cr, body_deadline, gov.max_body_bytes
+                    )
+                except _BodyTooLarge:
+                    gov.count("body_limit_total")
+                    self._put_static(queue, 413, b"request body too large\n")
+                    return
+                except _ReadTimeout:
+                    gov.count("deadline_closed_total")
+                    self._put_static(queue, 408, b"request body timeout\n")
+                    return
                 # Lenient decode mirrors the threaded parser; after a
                 # malformed chunk the connection framing is unknowable,
                 # so answer what was decoded, then close.
@@ -337,15 +596,37 @@ class AsyncIngestFrontend:
                 if cl:
                     try:
                         length = int(cl)
+                        if length < 0:
+                            raise ValueError
                     except ValueError:
                         self._put_static(queue, 400, b"bad content-length\n")
                         return
+                    if 0 <= gov.max_body_bytes < length:
+                        # Streaming enforcement: the declared size alone
+                        # rejects — the body is never buffered.
+                        gov.count("body_limit_total")
+                        self._put_static(queue, 413, b"request body too large\n")
+                        return
+                    if not is_ctl and not gov.can_admit(len(head) + length):
+                        gov.count("shed_total")
+                        self._put_shed(queue)
+                        return
                     if length > 0:
                         try:
-                            body = await reader.readexactly(length)
-                        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                            body = await cr.read_exactly(length, body_deadline)
+                        except _ReadTimeout:
+                            gov.count("deadline_closed_total")
+                            self._put_static(queue, 408, b"request body timeout\n")
                             return
-            self.bytes_total += len(head) + len(body)
+                        except _Truncated as terr:
+                            # Threaded parity: rfile.read() returns the
+                            # partial body at EOF and evaluates it; the
+                            # connection is gone either way.
+                            body = terr.partial
+                            close_after = True
+            nbytes = len(head) + len(body)
+            gov.charge(nbytes)
+            self.bytes_total += nbytes
             self.requests_total += 1
             conn_tok = special.get(b"connection", b"").lower()
             if version == b"HTTP/1.1":
@@ -355,63 +636,91 @@ class AsyncIngestFrontend:
             if close_after:
                 keep_alive = False
             fut = self._route(method, target, version, pairs, special, body, remote_b)
-            queue.put_nowait((fut, keep_alive))
+            queue.put_nowait((fut, keep_alive, nbytes))
             if not keep_alive:
                 return
-            if queue.qsize() >= MAX_PIPELINED:
-                # Pipelining backpressure: stop reading until the writer
-                # catches up (the client feels it as TCP backpressure).
-                while queue.qsize() >= MAX_PIPELINED // 2:
-                    await asyncio.sleep(0.001)
 
-    async def _read_chunked(self, reader) -> tuple[bytes, bool]:
+    async def _read_chunked(self, cr: _ConnReader, deadline, max_body: int):
         """Lenient chunked decode (threaded ``_read_chunked`` semantics:
         an unparsable size line stops decoding and evaluates what
-        arrived). Returns (body, malformed)."""
+        arrived). Returns (body, malformed); raises ``_BodyTooLarge``
+        the moment declared chunk sizes pass the ceiling (streaming
+        enforcement) and ``_ReadTimeout`` past the body deadline."""
         chunks: list[bytes] = []
+        total = 0
         while True:
             try:
-                size_line = await reader.readline()
-            except (ValueError, ConnectionError, OSError):
+                size_line = await cr.read_line(deadline)
+            except _Truncated:
                 return b"".join(chunks), True
             try:
                 size = int(size_line.strip().split(b";", 1)[0], 16)
             except ValueError:
                 return b"".join(chunks), True
+            if size < 0:
+                return b"".join(chunks), True
             if size == 0:
                 try:
-                    while (await reader.readline()).strip():  # trailers
+                    while (await cr.read_line(deadline)).strip():  # trailers
                         pass
-                except (ValueError, ConnectionError, OSError):
+                except _Truncated:
                     pass
                 return b"".join(chunks), False
+            total += size
+            if 0 <= max_body < total:
+                raise _BodyTooLarge
             try:
-                chunks.append(await reader.readexactly(size))
-                await reader.readline()  # CRLF after chunk data
-            except (asyncio.IncompleteReadError, ValueError, ConnectionError, OSError):
+                chunks.append(await cr.read_exactly(size, deadline))
+                await cr.read_line(deadline)  # CRLF after chunk data
+            except _Truncated as err:
+                if err.partial:
+                    chunks.append(err.partial)
                 return b"".join(chunks), True
 
-    async def _write_responses(self, queue, writer) -> None:
+    async def _write_responses(self, queue, writer, sem) -> None:
+        gov = self.sidecar.governor
+        write_timeout = gov.write_timeout_s
         try:
             while True:
                 item = await queue.get()
                 if item is None:
                     return
-                fut, keep_alive = item
+                fut, keep_alive, charge = item
                 try:
-                    status, payload, headers = await fut
-                except asyncio.CancelledError:
-                    raise
-                except Exception as err:
-                    log.error("ingest response future failed", err)
-                    status, payload, headers = (
-                        500,
-                        b"internal error\n",
-                        {"Content-Type": "text/plain"},
-                    )
-                writer.write(self._render(status, payload, headers, keep_alive))
-                if queue.empty():
-                    await writer.drain()
+                    try:
+                        status, payload, headers = await fut
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as err:
+                        log.error("ingest response future failed", err)
+                        status, payload, headers = (
+                            500,
+                            b"internal error\n",
+                            {"Content-Type": "text/plain"},
+                        )
+                    writer.write(self._render(status, payload, headers, keep_alive))
+                    transport = writer.transport
+                    if queue.empty() or (
+                        transport is not None
+                        and transport.get_write_buffer_size() > _WRITE_HIGH_WATER
+                    ):
+                        try:
+                            if write_timeout > 0:
+                                await asyncio.wait_for(writer.drain(), write_timeout)
+                            else:
+                                await writer.drain()
+                        except asyncio.TimeoutError:
+                            # Slow reader: responses are piling up in the
+                            # transport faster than the peer drains them.
+                            gov.count("slow_disconnects_total")
+                            try:
+                                writer.transport.abort()
+                            except Exception:
+                                pass
+                            return
+                finally:
+                    gov.discharge(charge)
+                    sem.release()
                 if not keep_alive:
                     return
         except (ConnectionError, OSError):
@@ -440,7 +749,20 @@ class AsyncIngestFrontend:
     def _put_static(self, queue, status: int, payload: bytes) -> None:
         fut = self._loop.create_future()
         fut.set_result((status, payload, {"Content-Type": "text/plain"}))
-        queue.put_nowait((fut, False))
+        queue.put_nowait((fut, False, 0))
+
+    def _put_shed(self, queue) -> None:
+        """Memory-budget shed: same 429 + Retry-After + x-waf-action
+        surface the queue-budget shed uses, so clients back off the same
+        way regardless of which budget tripped."""
+        sc = self.sidecar
+        err = Overloaded(
+            "ingress memory budget exceeded",
+            retry_after_s=sc.config.shed_retry_after_s,
+        )
+        fut = self._loop.create_future()
+        fut.set_result(sc.overloaded_reply(err, as_json=False))
+        queue.put_nowait((fut, False, 0))
 
     # -- routing -------------------------------------------------------------
 
@@ -595,7 +917,16 @@ class AsyncIngestFrontend:
         self._win_buf = bytearray()
         self.windows_total += 1
         self.window_requests_total += len(futs)
-        self._dispatch_window(blob, futs)
+        try:
+            self._dispatch_window(blob, futs)
+        except Exception as err:
+            # Dispatch containment: a routing bug answers this window
+            # 500 instead of leaving futures (and connections) hanging.
+            log.error("ingest window dispatch failed", err)
+            reply = (500, b"internal error\n", {"Content-Type": "text/plain"})
+            for f in futs:
+                if not f.done():
+                    f.set_result(reply)
 
     def _dispatch_window(self, blob: bytes, futs: list) -> None:
         """Route one assembled window. Runs on the loop thread — every
@@ -643,6 +974,18 @@ class AsyncIngestFrontend:
     def _window_done(self, wfut, futs, blob, engine, handle) -> None:
         self._inflight_windows -= 1
         handle.cancel()
+        sc = self.sidecar
+        try:
+            self._window_done_inner(wfut, futs, blob, engine)
+        except Exception as err:
+            log.error("ingest window completion failed", err)
+            reply = (500, b"internal error\n", {"Content-Type": "text/plain"})
+            for f in futs:
+                if not f.done():
+                    f.set_result(reply)
+            sc.governor.count("conn_errors_total")
+
+    def _window_done_inner(self, wfut, futs, blob, engine) -> None:
         sc = self.sidecar
         if wfut.cancelled():
             self._answer_all(futs, sc.unavailable_reply)

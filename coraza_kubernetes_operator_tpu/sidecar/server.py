@@ -58,6 +58,7 @@ from .degraded import (
     DegradedModeManager,
     Overloaded,
 )
+from .governor import BadContentLength, BodyTooLarge, IngressGovernor, MemoryShed
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .rollout import RolloutConfig, RolloutManager
 from .tenants import TENANT_HEADER, TenantManager
@@ -168,6 +169,25 @@ class SidecarConfig:
     # the cooldown before a half-open re-probe.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    # -- ingress governance (docs/SERVING.md "Overload & limits") -----------
+    # Shared by both frontends via sidecar.governor. None fields read
+    # their CKO_INGRESS_* env var (see sidecar/governor.py):
+    # CKO_INGRESS_MAX_CONNS (1024), CKO_INGRESS_HEADER_TIMEOUT_S (10),
+    # CKO_INGRESS_IDLE_TIMEOUT_S (75), CKO_INGRESS_BODY_TIMEOUT_S (30),
+    # CKO_INGRESS_WRITE_TIMEOUT_S (20), CKO_INGRESS_MAX_BODY_BYTES
+    # (10 MiB), CKO_INGRESS_MEMORY_BUDGET_BYTES (256 MiB). Timeouts of 0
+    # disable; negative caps/budgets disable.
+    max_connections: int | None = None
+    header_timeout_s: float | None = None
+    idle_timeout_s: float | None = None
+    body_timeout_s: float | None = None
+    write_timeout_s: float | None = None
+    max_body_bytes: int | None = None
+    ingress_memory_budget_bytes: int | None = None
+    # Shutdown drain budget: seconds stop() waits for in-flight ingest
+    # windows to resolve before force-closing remaining connections
+    # (force-closes are counted in cko_ingest_aborted_total).
+    drain_timeout_s: float = 2.0
     # -- staged ruleset rollout (docs/ROLLOUT.md) ----------------------------
     # Hot reloads stage a candidate in a budgeted background compile,
     # shadow-verify it on mirrored live traffic, and promote only after N
@@ -220,6 +240,17 @@ def _json_reply(status: int, obj, headers: dict | None = None) -> tuple[int, byt
     return status, json.dumps(obj).encode(), h
 
 
+# Probe/operator paths the byte-ledger shed never applies to (parity
+# with the async frontend's _CONTROL_TARGETS).
+_CONTROL_PATHS = {
+    API_PREFIX + "healthz",
+    API_PREFIX + "readyz",
+    API_PREFIX + "stats",
+    API_PREFIX + "metrics",
+    API_PREFIX + "rollback",
+}
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "cko-tpu-engine"
@@ -230,6 +261,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt: str, *args) -> None:
         log.debug("http " + fmt % args)
+
+    def handle(self) -> None:
+        """Connection governance for the threaded escape hatch: the same
+        global cap the async frontend enforces (one governor), plus the
+        idle timeout as a socket timeout so a quiet or trickling peer
+        cannot pin a handler thread forever."""
+        gov = self.sidecar.governor
+        if not gov.try_admit_conn():
+            try:
+                payload = b"too many connections\n"
+                self.wfile.write(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Type: text/plain\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + payload
+                )
+            except Exception:
+                pass
+            self.close_connection = True
+            return
+        try:
+            if gov.idle_timeout_s > 0:
+                self.connection.settimeout(gov.idle_timeout_s)
+            super().handle()
+        finally:
+            gov.release_conn()
 
     def _reply(self, status: int, payload: bytes, headers: dict | None = None) -> None:
         self.send_response(status)
@@ -244,29 +301,72 @@ class _Handler(BaseHTTPRequestHandler):
         h.update(headers or {})
         self._reply(status, json.dumps(obj).encode(), h)
 
+    def _is_control(self) -> bool:
+        return self.path.split("?", 1)[0] in _CONTROL_PATHS
+
     def _read_body(self) -> bytes:
         # A WAF must see the body however it is framed: chunked bodies are
         # decoded (not evaluating them would be a rule bypass, and leaving
-        # them unread desyncs HTTP/1.1 keep-alive framing).
+        # them unread desyncs HTTP/1.1 keep-alive framing). Governance
+        # (same taxonomy as the async frontend): unparsable
+        # Content-Length → BadContentLength (400, previously an uncaught
+        # ValueError that silently dropped the connection), declared size
+        # over the ceiling → BodyTooLarge (413) before any buffering,
+        # byte-ledger exhaustion → MemoryShed (429, control paths exempt).
+        gov = self.sidecar.governor
         if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
-            return self._read_chunked()
-        length = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(length) if length > 0 else b""
+            if not self._is_control() and not gov.can_admit(0):
+                gov.count("shed_total")
+                raise MemoryShed
+            return self._read_chunked(gov.max_body_bytes)
+        raw = self.headers.get("Content-Length")
+        if raw is None or not raw.strip():
+            return b""
+        try:
+            length = int(raw)
+            if length < 0:
+                raise ValueError
+        except ValueError:
+            raise BadContentLength from None
+        if length == 0:
+            return b""
+        if 0 <= gov.max_body_bytes < length:
+            raise BodyTooLarge
+        if not self._is_control() and not gov.can_admit(length):
+            gov.count("shed_total")
+            raise MemoryShed
+        return self.rfile.read(length)
 
-    def _read_chunked(self) -> bytes:
+    def _read_chunked(self, max_body: int = -1) -> bytes:
         chunks: list[bytes] = []
+        total = 0
         while True:
             size_line = self.rfile.readline(65536).strip()
             try:
                 size = int(size_line.split(b";", 1)[0], 16)
             except ValueError:
+                # Lenient decode: evaluate what arrived, but the framing
+                # is now unknowable — close after answering.
+                self.close_connection = True
+                break
+            if size < 0:
+                self.close_connection = True
                 break
             if size == 0:
                 # Trailers until blank line.
                 while self.rfile.readline(65536).strip():
                     pass
                 break
-            chunks.append(self.rfile.read(size))
+            total += size
+            if 0 <= max_body < total:
+                # Streaming enforcement: declared chunk sizes alone trip
+                # the ceiling — the rest is never buffered.
+                raise BodyTooLarge
+            data = self.rfile.read(size)
+            chunks.append(data)
+            if len(data) < size:  # truncated mid-chunk
+                self.close_connection = True
+                break
             self.rfile.readline(65536)  # CRLF after chunk data
         return b"".join(chunks)
 
@@ -290,16 +390,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_filter(b"")
 
     def do_POST(self) -> None:  # noqa: N802
+        gov = self.sidecar.governor
         path = self.path.split("?", 1)[0]
-        body = self._read_body()
-        if path == API_PREFIX + "evaluate":
-            self._handle_bulk(body)
-        elif path == API_PREFIX + "rollback":
-            self._handle_rollback(body)
-        elif path.startswith(API_PREFIX):
-            self._reply_json(404, {"error": "not found"})
-        else:
-            self._handle_filter(body)
+        try:
+            body = self._read_body()
+        except BadContentLength:
+            self.close_connection = True
+            self._reply(400, b"bad content-length\n", {"Content-Type": "text/plain"})
+            return
+        except BodyTooLarge:
+            gov.count("body_limit_total")
+            self.close_connection = True
+            self._reply(
+                413, b"request body too large\n", {"Content-Type": "text/plain"}
+            )
+            return
+        except MemoryShed:
+            self.close_connection = True
+            err = Overloaded(
+                "ingress memory budget exceeded",
+                retry_after_s=self.sidecar.config.shed_retry_after_s,
+            )
+            self._reply(*self.sidecar.overloaded_reply(err, as_json=False))
+            return
+        except TimeoutError:
+            gov.count("deadline_closed_total")
+            self.close_connection = True
+            try:
+                self._reply(
+                    408, b"request body timeout\n", {"Content-Type": "text/plain"}
+                )
+            except Exception:
+                pass
+            return
+        except ConnectionError:
+            self.close_connection = True
+            return
+        gov.charge(len(body))
+        try:
+            if path == API_PREFIX + "evaluate":
+                self._handle_bulk(body)
+            elif path == API_PREFIX + "rollback":
+                self._handle_rollback(body)
+            elif path.startswith(API_PREFIX):
+                self._reply_json(404, {"error": "not found"})
+            else:
+                self._handle_filter(body)
+        finally:
+            gov.discharge(len(body))
 
     do_PUT = do_PATCH = do_DELETE = do_POST  # noqa: N815
 
@@ -367,6 +505,20 @@ class TpuEngineSidecar:
 
     def __init__(self, config: SidecarConfig, engine: WafEngine | None = None):
         self.config = config
+        # Ingress governance (docs/SERVING.md "Overload & limits"): ONE
+        # governor shared by whichever frontend serves — connection cap,
+        # read deadlines, body ceiling, and the in-flight byte ledger are
+        # frontend-independent invariants. Constructed before the
+        # frontend so both can capture it.
+        self.governor = IngressGovernor(
+            max_connections=config.max_connections,
+            header_timeout_s=config.header_timeout_s,
+            idle_timeout_s=config.idle_timeout_s,
+            body_timeout_s=config.body_timeout_s,
+            write_timeout_s=config.write_timeout_s,
+            max_body_bytes=config.max_body_bytes,
+            memory_budget_bytes=config.ingress_memory_budget_bytes,
+        )
         keys = [k.strip() for k in config.instance_key.split(",") if k.strip()]
         # Staged ruleset rollout (docs/ROLLOUT.md): budgeted background
         # candidate compiles, shadow-traffic verification against the
@@ -658,6 +810,52 @@ class TpuEngineSidecar:
             "cko_ingest_bytes_total",
             "Request bytes read by the async ingest frontend",
         ).set_function(lambda: float(self._frontend_stat("bytes_total")))
+        self.metrics.gauge(
+            "cko_ingest_aborted_total",
+            "Connections force-closed when the shutdown drain budget expired",
+        ).set_function(lambda: float(self.governor.aborted_total))
+        # -- ingress governance (docs/SERVING.md "Overload & limits") -------
+        gov = self.governor
+        self.metrics.gauge(
+            "cko_ingress_active_connections",
+            "Connections currently admitted under the global cap",
+        ).set_function(lambda: float(gov.connections))
+        self.metrics.gauge(
+            "cko_ingress_max_connections",
+            "Configured global connection cap (negative disables)",
+        ).set_function(lambda: float(gov.max_connections))
+        self.metrics.gauge(
+            "cko_ingress_inflight_bytes",
+            "Request bytes held in flight (parse buffers + bodies + windows)",
+        ).set_function(lambda: float(gov.inflight_bytes))
+        self.metrics.gauge(
+            "cko_ingress_memory_budget_bytes",
+            "Configured in-flight byte budget (negative disables)",
+        ).set_function(lambda: float(gov.memory_budget_bytes))
+        self.metrics.gauge(
+            "cko_ingress_conns_rejected_total",
+            "Connections refused 503 at the global connection cap",
+        ).set_function(lambda: float(gov.conns_rejected_total))
+        self.metrics.gauge(
+            "cko_ingress_shed_total",
+            "Requests shed 429 by the in-flight byte budget",
+        ).set_function(lambda: float(gov.shed_total))
+        self.metrics.gauge(
+            "cko_ingress_deadline_closed_total",
+            "Connections answered 408 by a header/body read deadline",
+        ).set_function(lambda: float(gov.deadline_closed_total))
+        self.metrics.gauge(
+            "cko_ingress_body_limit_total",
+            "Requests rejected 413 by the streaming body-size ceiling",
+        ).set_function(lambda: float(gov.body_limit_total))
+        self.metrics.gauge(
+            "cko_ingress_slow_disconnects_total",
+            "Connections aborted because the peer drained responses too slowly",
+        ).set_function(lambda: float(gov.slow_disconnects_total))
+        self.metrics.gauge(
+            "cko_ingress_conn_errors_total",
+            "Poisoned connections contained (reader/writer exceptions)",
+        ).set_function(lambda: float(gov.conn_errors_total))
         self._serve_thread: threading.Thread | None = None
 
     def _frontend_stat(self, field: str):
@@ -1352,6 +1550,10 @@ class TpuEngineSidecar:
                 if self._frontend is not None
                 else {"mode": "threaded"}
             ),
+            "ingress": {
+                **self.governor.stats(),
+                "window_bytes_pending": self.batcher.pending_bytes(),
+            },
         }
 
     # -- lifecycle -----------------------------------------------------------

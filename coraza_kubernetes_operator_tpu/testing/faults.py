@@ -30,6 +30,26 @@ Knobs (all default off):
   (``CKO_FAULT_SHADOW_DIVERGE_SEED``) so it never perturbs the
   device-error stream's reproducibility.
 
+Adversarial *ingress* knobs (consumed by traffic generators —
+``hack/ingest_fuzz.py`` and the chaos ``ingress-storm`` clients — to
+shape the bytes they send at the frontends; the server never reads
+them):
+
+- ``CKO_FAULT_SLOW_CLIENT_DELAY_S=<seconds>``: clients pace their sends
+  byte-group by byte-group with this inter-send delay (slowloris /
+  slow-body simulation driving the 408 read deadlines).
+- ``CKO_FAULT_CLIENT_RESET_RATE=<0..1>``: each request is abandoned
+  mid-stream with a hard RST (SO_LINGER 0) with this probability.
+- ``CKO_FAULT_CHUNK_TRUNCATE_RATE=<0..1>``: each chunked request ends
+  truncated mid-chunk with this probability.
+- ``CKO_FAULT_CHUNK_OVERSIZE_RATE=<0..1>``: each chunked request
+  declares a chunk size past the body ceiling with this probability
+  (driving the streaming 413).
+- ``CKO_FAULT_CONN_STORM=<n>``: storm clients open this many extra
+  concurrent connections (driving the global connection cap's 503).
+- ``CKO_FAULT_INGRESS_SEED=<int>``: one shared PRNG seed for all the
+  ingress-client draws above (default 0) — a storm replays exactly.
+
 The hooks are called from production code (``engine/waf.py``,
 ``sidecar/reloader.py``) and are no-ops (a few ns of ``os.environ``
 lookups) when the knobs are unset — the serving hot path never pays for
@@ -129,6 +149,67 @@ def injected_shadow_diverge() -> bool:
             _shadow_rng = random.Random(seed)
             _shadow_rng_seed = seed
         return _shadow_rng.random() < rate
+
+
+_ingress_rng_lock = threading.Lock()
+_ingress_rng: random.Random | None = None
+_ingress_rng_seed: int | None = None
+
+
+def _ingress_rate(name: str) -> float:
+    try:
+        return float(os.environ.get(name, "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def _ingress_draw(rate: float) -> bool:
+    """One draw from the shared seeded ingress-client PRNG
+    (``CKO_FAULT_INGRESS_SEED``; reseeds when the seed knob changes)."""
+    global _ingress_rng, _ingress_rng_seed
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    seed = int(os.environ.get("CKO_FAULT_INGRESS_SEED", "0"))
+    with _ingress_rng_lock:
+        if _ingress_rng is None or seed != _ingress_rng_seed:
+            _ingress_rng = random.Random(seed)
+            _ingress_rng_seed = seed
+        return _ingress_rng.random() < rate
+
+
+def injected_client_delay_s() -> float:
+    """Inter-send pacing for adversarial clients
+    (``CKO_FAULT_SLOW_CLIENT_DELAY_S``; 0 = send at full speed)."""
+    return max(0.0, _ingress_rate("CKO_FAULT_SLOW_CLIENT_DELAY_S"))
+
+
+def injected_client_reset() -> bool:
+    """True when this client request should abandon mid-stream with a
+    hard reset (``CKO_FAULT_CLIENT_RESET_RATE``)."""
+    return _ingress_draw(_ingress_rate("CKO_FAULT_CLIENT_RESET_RATE"))
+
+
+def injected_chunk_truncate() -> bool:
+    """True when this chunked request should end truncated mid-chunk
+    (``CKO_FAULT_CHUNK_TRUNCATE_RATE``)."""
+    return _ingress_draw(_ingress_rate("CKO_FAULT_CHUNK_TRUNCATE_RATE"))
+
+
+def injected_chunk_oversize() -> bool:
+    """True when this chunked request should declare a chunk past the
+    body ceiling (``CKO_FAULT_CHUNK_OVERSIZE_RATE``)."""
+    return _ingress_draw(_ingress_rate("CKO_FAULT_CHUNK_OVERSIZE_RATE"))
+
+
+def injected_conn_storm() -> int:
+    """Extra concurrent connections storm clients should open
+    (``CKO_FAULT_CONN_STORM``; 0 = no storm)."""
+    try:
+        return max(0, int(os.environ.get("CKO_FAULT_CONN_STORM", "0") or 0))
+    except ValueError:
+        return 0
 
 
 def cache_outage_active() -> bool:

@@ -17,6 +17,13 @@ the fault-injection harness (``testing/faults.py``) end to end:
    half-open probe re-promotes;
 5. **cache outage** (``CKO_FAULT_CACHE_OUTAGE=1``) — polls fail and back
    off; outage clears and polling resumes.
+6. **ingress storm** (ISSUE 11) — a slowloris herd (sized by
+   ``CKO_FAULT_CONN_STORM``), a pipelined keep-alive flood, and
+   malformed/oversized senders hit the live sidecar at once: the
+   verdict storm stays bit-correct, probes stay green, every
+   adversarial connection is reaped (408 deadline / streaming 413,
+   accounted in the governor counters), the in-flight byte ledger
+   returns to zero, and process RSS stays bounded.
 
 Throughout, a background traffic storm asserts every response is a real
 verdict (200/403, correct per request) — never a blank 500 — and at the
@@ -28,6 +35,9 @@ Exit 0 on pass; 1 with a JSON diagnostic line on fail.
 
 import json
 import os
+import re
+import resource
+import socket
 import sys
 import threading
 import time
@@ -205,6 +215,108 @@ def main() -> int:
         if not _wait(lambda: sc.reloader.consecutive_poll_failures == 0, 30):
             return _fail("cache_outage_recovery", detail="polls never recovered")
 
+        # 6. Ingress storm: slowloris herd + pipelined flood + malformed
+        # and oversized senders, all against the live sidecar while the
+        # verdict storm keeps asserting correctness.
+        from coraza_kubernetes_operator_tpu.testing import faults
+
+        gov = sc.governor
+        gov.header_timeout_s = 1.0  # reap the slowloris herd fast
+        gov.max_body_bytes = 65536
+        os.environ["CKO_FAULT_CONN_STORM"] = "20"
+        herd_size = faults.injected_conn_storm()
+        rss_before_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        deadline_before = gov.deadline_closed_total
+        body_limit_before = gov.body_limit_total
+
+        herd = []
+        for _ in range(herd_size):  # partial heads, never completed
+            s = socket.create_connection(("127.0.0.1", sc.port), timeout=10)
+            s.sendall(b"GET / HTTP/1.1\r\nHost: slow")
+            herd.append(s)
+
+        def _raw_statuses(payload: bytes, timeout=30.0) -> list:
+            s = socket.create_connection(("127.0.0.1", sc.port), timeout=timeout)
+            try:
+                s.sendall(payload)
+                s.shutdown(socket.SHUT_WR)
+                raw = b""
+                while True:
+                    data = s.recv(65536)
+                    if not data:
+                        break
+                    raw += data
+            finally:
+                s.close()
+            # Response bodies end with a bare LF, so status lines are not
+            # always on \r\n boundaries — match them positionally.
+            return [int(c) for c in re.findall(rb"HTTP/1\.1 (\d{3}) ", raw)]
+
+        storm_bad = []
+        for round_i in range(8):
+            # Pipelined keep-alive flood: 200 requests, one connection.
+            n = 200
+            flood = b"".join(
+                b"GET /?i=%d%s HTTP/1.1\r\nHost: flood\r\n%s\r\n"
+                % (i, b"&pet=evilmonkey" if i % 3 == 0 else b"",
+                   b"Connection: close\r\n" if i == n - 1 else b"")
+                for i in range(n)
+            )
+            got = _raw_statuses(flood)
+            want = [403 if i % 3 == 0 else 200 for i in range(n)]
+            if got != want:
+                storm_bad.append((round_i, "flood", got[:5], len(got)))
+            # Malformed + oversized senders (taxonomy is fuzz-gated;
+            # here the invariant is: answered, never hung, accounted).
+            for payload in (
+                b"POST / HTTP/1.1\r\nHost: t\r\nContent-Length: zz\r\n\r\n",
+                b"POST / HTTP/1.1\r\nHost: t\r\nContent-Length: 100000\r\n"
+                b"Connection: close\r\n\r\n",
+                b"POST / HTTP/1.1\r\nHost: t\r\nTransfer-Encoding: chunked\r\n"
+                b"\r\n40\r\ntrunc",
+                b"jnkgarbage\r\n\r\n",
+            ):
+                if not _raw_statuses(payload):
+                    storm_bad.append((round_i, "malformed_unanswered", payload[:40]))
+            # Probes stay green mid-storm.
+            if _http(sc.port, "/waf/v1/healthz")[0] != 200:
+                storm_bad.append((round_i, "healthz"))
+            if _http(sc.port, "/waf/v1/readyz")[0] != 200:
+                storm_bad.append((round_i, "readyz"))
+        if storm_bad:
+            return _fail("ingress_storm", bad=storm_bad[:5], total=len(storm_bad))
+        # The slowloris herd is reaped by the header deadline (408s
+        # accounted), not left holding slots.
+        if not _wait(
+            lambda: gov.deadline_closed_total >= deadline_before + herd_size, 30
+        ):
+            return _fail(
+                "ingress_storm",
+                detail="slowloris herd not reaped",
+                deadline_closed=gov.deadline_closed_total - deadline_before,
+            )
+        for s in herd:
+            s.close()
+        if gov.body_limit_total <= body_limit_before:
+            return _fail("ingress_storm", detail="oversized sends not accounted")
+        if not _wait(lambda: gov.inflight_bytes == 0, 30):
+            return _fail(
+                "ingress_storm", detail="inflight bytes leaked",
+                inflight=gov.inflight_bytes,
+            )
+        if not _wait(lambda: gov.connections <= 2, 30):  # live storm only
+            return _fail(
+                "ingress_storm", detail="connections leaked",
+                connections=gov.connections,
+            )
+        rss_grown_kb = (
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss_before_kb
+        )
+        if rss_grown_kb > 128 * 1024:
+            return _fail("ingress_storm", detail="RSS unbounded",
+                         grown_kb=rss_grown_kb)
+        del os.environ["CKO_FAULT_CONN_STORM"]
+
         stop.set()
         storm_thread.join(timeout=10)
         if storm_thread.is_alive():
@@ -238,6 +350,10 @@ def main() -> int:
             # sleeping out its injected 30s stall; it is discarded and
             # exits on wake — everything else must be gone.
             and not t.name.startswith("cko-rollout-")
+            # Scenario 6's pipelined floods mint batch shapes the tier
+            # pool is still compiling; the daemon workers discard the
+            # executable and exit when the compile returns.
+            and not t.name.startswith("cko-tier-compile")
         ]
         if not hung:
             break
@@ -252,6 +368,7 @@ def main() -> int:
                 "final_mode": sc.serving_mode(),
                 "rollouts": rollout.stats() if rollout else None,
                 "storm_requests_bad": len(bad),
+                "ingress": sc.governor.stats(),
             }
         )
     )

@@ -1,0 +1,512 @@
+#!/usr/bin/env python
+"""Seeded protocol fuzz for both ingest frontends (ISSUE 11 tentpole c).
+
+Builds ONE deterministic corpus of mutated HTTP/1.x byte streams
+(``CKO_FUZZ_SEED``, default 0; ``CKO_FUZZ_ITERS`` connections per
+frontend, default 2000) and replays the identical bytes against the
+async and the threaded frontend of one shared engine, asserting:
+
+1. **the acceptor loop never crashes** — every connection either gets
+   answered or closed; a hang (read timeout with the server holding the
+   socket open) is a failure;
+2. **nothing leaks** — after the storm, active connections, the
+   governor's in-flight byte ledger, and the batcher's in-flight
+   windows all return to zero, and a canary attack still gets its 403
+   (healthz 200) on the very same process;
+3. **identical error taxonomy** — per connection, the normalized status
+   sequence (400/413/429/501/503/505 + verdict statuses) must match
+   bit-for-bit across frontends. Parity is by construction: one corpus,
+   two replays, one diff.
+
+Normalization bridges two documented stdlib quirks, not policy
+differences: ``BaseHTTPRequestHandler`` answers some malformed request
+lines in HTTP/0.9 style (bare HTML error body, no status line — the
+``Error code: NNN`` text is parsed instead), and long request
+lines/header sections get 414/431 where the async frontend folds both
+into 400 ({400, 414, 431} → "reject").
+
+The corpus generators consume the adversarial-ingress fault knobs
+(``testing/faults.py``): ``CKO_FAULT_CLIENT_RESET_RATE`` aborts
+requests mid-stream with a hard RST, ``CKO_FAULT_CHUNK_TRUNCATE_RATE``
+/ ``CKO_FAULT_CHUNK_OVERSIZE_RATE`` reshape chunked requests, and
+``CKO_FAULT_SLOW_CLIENT_DELAY_S`` paces every send. All default off;
+the built-in families cover the same shapes at fixed weights either
+way.
+
+Timeouts (408) are deliberately absent: the corpus only sends complete
+byte streams, and the two frontends document different deadline
+behavior for silent partial heads (tests/test_ingress_governance.py
+covers 408 per frontend).
+
+Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import argparse
+import json
+import os
+import random
+import re
+import socket
+import string
+import struct
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+EVIL_MONKEY = (
+    'SecRule ARGS|REQUEST_URI "@contains evilmonkey" '
+    '"id:3001,phase:2,deny,status:403"\n'
+)
+
+MAX_BODY = 4096  # small ceiling so the 413 families stay cheap
+
+STATUS_RE = re.compile(rb"HTTP/1\.[01] (\d{3}) ")
+BARE_ERROR_RE = re.compile(rb"Error code: (\d{3})")
+# Long request lines / header sections: stdlib answers 414/431 where the
+# async frontend folds both into its head-overrun 400.
+NORMALIZE = {400: "reject", 414: "reject", 431: "reject"}
+
+
+def _fail(stage: str, **detail) -> int:
+    print(json.dumps({"ingest_fuzz": "FAIL", "stage": stage, **detail}))
+    return 1
+
+
+def _wait(predicate, timeout_s=60.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# -- corpus -------------------------------------------------------------------
+
+
+def _word(rng, lo=3, hi=12):
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(rng.randint(lo, hi)))
+
+
+def _body_text(rng, max_len=2048):
+    """Form-ish body; ~1 in 3 carries the attack token so the verdict
+    families exercise both outcomes."""
+    parts = [f"{_word(rng)}={_word(rng)}" for _ in range(rng.randint(1, 6))]
+    if rng.random() < 0.33:
+        parts.append(f"pet=evilmonkey{rng.randint(0, 999)}")
+    return ("&".join(parts))[:max_len].encode()
+
+
+def _noise_value(rng):
+    """Header-value noise: printable ASCII plus occasional latin-1 high
+    bytes — never CR/LF (structure stays intact; only values mutate)."""
+    n = rng.randint(1, 60)
+    out = bytearray()
+    for _ in range(n):
+        out.append(rng.choice(b"!#$%&'()*+,-./:;<=>?@[]^_`{|}~ ")
+                   if rng.random() < 0.8 else rng.randint(0xA0, 0xFF))
+    return bytes(out)
+
+
+def _get(rng, uri, version=b"HTTP/1.1", close=True):
+    conn = b"Connection: close\r\n" if close else b""
+    return b"GET " + uri + b" " + version + b"\r\nHost: fuzz\r\n" + conn + b"\r\n"
+
+
+def _post(rng, body, headers=b"", uri=b"/submit", cl=None):
+    cl_val = str(len(body) if cl is None else cl).encode()
+    return (
+        b"POST " + uri + b" HTTP/1.1\r\nHost: fuzz\r\n"
+        + headers
+        + b"Content-Length: " + cl_val + b"\r\nConnection: close\r\n\r\n"
+        + body
+    )
+
+
+def _chunked(rng, chunks, tail=b"0\r\n\r\n", headers=b""):
+    wire = b"".join(
+        ("%x" % len(c)).encode() + b"\r\n" + c + b"\r\n" for c in chunks
+    )
+    return (
+        b"POST /submit HTTP/1.1\r\nHost: fuzz\r\n" + headers
+        + b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        + wire + tail
+    )
+
+
+def _fam_clean_get(rng):
+    return _get(rng, f"/{_word(rng)}?q={_word(rng)}".encode()), True
+
+
+def _fam_attack_get(rng):
+    return _get(rng, f"/?pet=evilmonkey{rng.randint(0, 999)}".encode()), True
+
+
+def _fam_post_cl(rng):
+    return _post(rng, _body_text(rng)), True
+
+
+def _fam_chunked_ok(rng):
+    chunks = [_body_text(rng, 512) for _ in range(rng.randint(1, 4))]
+    return _chunked(rng, chunks), True
+
+
+def _fam_pipelined(rng):
+    k = rng.randint(2, 12)
+    out = []
+    for i in range(k):
+        uri = (f"/?pet=evilmonkey&i={i}" if rng.random() < 0.5 else f"/ok{i}").encode()
+        out.append(_get(rng, uri, close=(i == k - 1)))
+    return b"".join(out), True
+
+
+def _fam_http10(rng):
+    return _get(rng, b"/" + _word(rng).encode(), version=b"HTTP/1.0", close=False), True
+
+
+def _fam_garbage_line(rng):
+    words = " ".join(_word(rng) for _ in range(rng.randint(1, 2)))
+    return words.encode() + b"\r\n\r\n", True
+
+
+def _fam_unknown_method(rng):
+    m = rng.choice(["BREW", "HEAD", "OPTIONS", "TRACE", "CONNECT"])
+    return m.encode() + b" / HTTP/1.1\r\nHost: fuzz\r\nConnection: close\r\n\r\n", True
+
+
+def _fam_bad_cl(rng):
+    bad = rng.choice([b"abc", b"-7", b"1e3", b"0x10", b""])
+    return (
+        b"POST /submit HTTP/1.1\r\nHost: fuzz\r\nContent-Length: " + bad
+        + b"\r\nConnection: close\r\n\r\n"
+    ), True
+
+
+def _fam_oversized_cl(rng):
+    # Declared over the ceiling; the 413 must land BEFORE any body is
+    # read, so no body bytes are sent at all.
+    return _post(rng, b"", cl=rng.randint(MAX_BODY + 1, MAX_BODY * 16)), True
+
+
+def _fam_truncated_cl(rng):
+    body = _body_text(rng)
+    declared = len(body) + rng.randint(1, 512)
+    return _post(rng, body, cl=declared), True
+
+
+def _fam_extra_bytes(rng):
+    # Correctly framed POST followed by a pipelined clean GET: the
+    # trailing bytes must be parsed as the next request, not as body.
+    return _fam_post_cl(rng)[0][:-1] + b"x" + _get(rng, b"/after"), True
+
+
+def _fam_chunked_bad_size(rng):
+    chunks = [_body_text(rng, 256)] if rng.random() < 0.5 else []
+    bad = rng.choice([b"zz", b"-5", b"0x", b""])
+    return _chunked(rng, chunks, tail=bad + b"\r\n"), True
+
+
+def _fam_chunked_truncated(rng):
+    chunks = [_body_text(rng, 256) for _ in range(rng.randint(0, 2))]
+    partial = _body_text(rng, 256)
+    declared = len(partial) + rng.randint(1, 256)
+    tail = ("%x" % declared).encode() + b"\r\n" + partial
+    return _chunked(rng, chunks, tail=tail), True
+
+
+def _fam_chunked_oversized(rng):
+    tail = ("%x" % rng.randint(MAX_BODY + 1, MAX_BODY * 16)).encode() + b"\r\n"
+    return _chunked(rng, [], tail=tail), True
+
+
+def _fam_bad_version(rng):
+    v = rng.choice([b"HTTP/2.0", b"HTTP/3.0", b"HTTP/9.9", b"HTTP/x.y", b"HTCPCP/1.0"])
+    return b"GET / " + v + b"\r\nHost: fuzz\r\n\r\n", True
+
+
+def _fam_header_noise(rng):
+    headers = b"".join(
+        name + b": " + _noise_value(rng) + b"\r\n"
+        for name in (b"User-Agent", b"Cookie", b"X-Fuzz", b"Referer")
+        if rng.random() < 0.8
+    )
+    if rng.random() < 0.5:
+        return _post(rng, _body_text(rng), headers=headers), True
+    return (
+        b"GET /?a=" + _word(rng).encode() + b" HTTP/1.1\r\nHost: fuzz\r\n"
+        + headers + b"Connection: close\r\n\r\n"
+    ), True
+
+
+FAMILIES = [
+    ("clean_get", _fam_clean_get, 10),
+    ("attack_get", _fam_attack_get, 8),
+    ("post_cl", _fam_post_cl, 10),
+    ("chunked_ok", _fam_chunked_ok, 8),
+    ("pipelined", _fam_pipelined, 6),
+    ("http10", _fam_http10, 3),
+    ("garbage_line", _fam_garbage_line, 4),
+    ("unknown_method", _fam_unknown_method, 4),
+    ("bad_cl", _fam_bad_cl, 4),
+    ("oversized_cl", _fam_oversized_cl, 5),
+    ("truncated_cl", _fam_truncated_cl, 5),
+    ("extra_bytes", _fam_extra_bytes, 4),
+    ("chunked_bad_size", _fam_chunked_bad_size, 5),
+    ("chunked_truncated", _fam_chunked_truncated, 5),
+    ("chunked_oversized", _fam_chunked_oversized, 5),
+    ("bad_version", _fam_bad_version, 3),
+    ("header_noise", _fam_header_noise, 6),
+]
+RESET_RATE = 0.03  # built-in mid-stream RST floor (parity not compared)
+
+
+def build_corpus(seed: int, iters: int):
+    """One deterministic corpus; the same bytes go to both frontends."""
+    from coraza_kubernetes_operator_tpu.testing import faults
+
+    rng = random.Random(seed)
+    names = [f[0] for f in FAMILIES]
+    gens = {f[0]: f[1] for f in FAMILIES}
+    weights = [f[2] for f in FAMILIES]
+    corpus = []
+    for _ in range(iters):
+        name = rng.choices(names, weights=weights)[0]
+        # Fault knobs reshape the draw (all default off).
+        if name == "chunked_ok" and faults.injected_chunk_truncate():
+            name = "chunked_truncated"
+        if name == "chunked_ok" and faults.injected_chunk_oversize():
+            name = "chunked_oversized"
+        payload, compare = gens[name](rng)
+        reset = rng.random() < RESET_RATE or faults.injected_client_reset()
+        corpus.append((name, payload, compare and not reset, reset))
+    return corpus
+
+
+# -- exchange + classification ------------------------------------------------
+
+
+def exchange(port, payload, reset=False, timeout=20.0):
+    """Send one corpus entry on a fresh connection, read to EOF.
+    Returns raw response bytes, or None for a RST entry, or the string
+    "hang" when the server never closed its end."""
+    from coraza_kubernetes_operator_tpu.testing import faults
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        if reset:
+            s.sendall(payload[: max(1, len(payload) // 2)])
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+            return None
+        delay = faults.injected_client_delay_s()
+        if delay > 0:
+            for i in range(0, len(payload), 256):
+                s.sendall(payload[i : i + 256])
+                time.sleep(delay)
+        else:
+            s.sendall(payload)
+        try:
+            s.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        chunks = []
+        while True:
+            try:
+                data = s.recv(65536)
+            except socket.timeout:
+                return "hang"
+            except ConnectionError:
+                break
+            if not data:
+                break
+            chunks.append(data)
+        return b"".join(chunks)
+    finally:
+        s.close()
+
+
+def classify(raw):
+    """Normalized status sequence for one connection's response bytes."""
+    if raw == "hang":
+        return ("hang",)
+    if not raw:
+        return ("closed",)
+    codes = [int(c) for c in STATUS_RE.findall(raw)]
+    if not codes:
+        # BaseHTTPRequestHandler HTTP/0.9-style error: bare HTML body,
+        # no status line — the embedded error code carries the taxonomy.
+        codes = [int(c) for c in BARE_ERROR_RE.findall(raw)]
+    if not codes:
+        return ("reject",)
+    return tuple(NORMALIZE.get(c, str(c)) for c in codes)
+
+
+# -- per-frontend run ---------------------------------------------------------
+
+
+def run_frontend(frontend, engine, corpus, sc_cls, cfg_cls):
+    sc = sc_cls(
+        cfg_cls(
+            host="127.0.0.1",
+            port=0,
+            frontend=frontend,
+            max_batch_size=64,
+            max_batch_delay_ms=1.0,
+            max_body_bytes=MAX_BODY,
+            # Generous deadlines: the corpus sends complete streams, so
+            # 408 stays out of the parity gate by construction.
+            header_timeout_s=30.0,
+            idle_timeout_s=30.0,
+            body_timeout_s=30.0,
+            max_connections=256,
+        ),
+        engine=engine,
+    )
+    sc.start()
+    out = {"classes": [], "hangs": 0}
+    try:
+        if not _wait(sc.ready, 120):
+            raise RuntimeError("sidecar never became ready")
+        if not _wait(lambda: sc.serving_mode() == "promoted", timeout_s=120):
+            raise RuntimeError(f"never promoted: {sc.serving_mode()}")
+        for _name, payload, _compare, reset in corpus:
+            raw = exchange(sc.port, payload, reset=reset)
+            cls = None if raw is None else classify(raw)
+            if cls == ("hang",):
+                out["hangs"] += 1
+            out["classes"].append(cls)
+
+        # -- leak + liveness gate on the very same process ----------------
+        gov = sc.governor
+        out["leak_conns"] = not _wait(lambda: gov.connections == 0, 30)
+        out["leak_bytes"] = not _wait(lambda: gov.inflight_bytes == 0, 30)
+        out["leak_windows"] = not _wait(
+            lambda: sc.batcher.inflight_windows() == 0, 30
+        )
+        canary = classify(
+            exchange(sc.port, _get(None, b"/?pet=evilmonkey"))
+        )
+        health = classify(
+            exchange(sc.port, _get(None, b"/waf/v1/healthz"))
+        )
+        out["canary"] = canary
+        out["health"] = health
+        out["governor"] = gov.stats()
+        out["inflight_bytes"] = gov.inflight_bytes
+        out["connections"] = gov.connections
+    finally:
+        sc.stop()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--iters", type=int,
+        default=int(os.environ.get("CKO_FUZZ_ITERS", "2000") or 2000),
+        help="corpus connections per frontend (default $CKO_FUZZ_ITERS or 2000)",
+    )
+    ap.add_argument(
+        "--seed", type=int,
+        default=int(os.environ.get("CKO_FUZZ_SEED", "0") or 0),
+        help="corpus PRNG seed (default $CKO_FUZZ_SEED or 0)",
+    )
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO))
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+
+    configure_persistent_cache(
+        os.environ.get("CKO_COMPILE_CACHE_DIR") or str(REPO / ".jax_bench_cache")
+    )
+    from coraza_kubernetes_operator_tpu.engine import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+
+    t0 = time.monotonic()
+    corpus = build_corpus(args.seed, args.iters)
+    engine = WafEngine(BASE + EVIL_MONKEY)
+
+    results = {}
+    for frontend in ("async", "threaded"):
+        try:
+            results[frontend] = run_frontend(
+                frontend, engine, corpus, TpuEngineSidecar, SidecarConfig
+            )
+        except Exception as err:
+            return _fail(
+                f"{frontend}_run", error=f"{type(err).__name__}: {err}"
+            )
+
+    # -- the gates ------------------------------------------------------------
+    for frontend, r in results.items():
+        if r["hangs"]:
+            return _fail("hang", frontend=frontend, hangs=r["hangs"])
+        for leak in ("leak_conns", "leak_bytes", "leak_windows"):
+            if r[leak]:
+                return _fail(
+                    "leak", frontend=frontend, which=leak,
+                    connections=r["connections"],
+                    inflight_bytes=r["inflight_bytes"],
+                )
+        if r["canary"] != ("403",):
+            return _fail("canary", frontend=frontend, got=r["canary"])
+        if r["health"] != ("200",):
+            return _fail("health", frontend=frontend, got=r["health"])
+
+    divergences = []
+    for i, (name, payload, compare, _reset) in enumerate(corpus):
+        if not compare:
+            continue
+        a = results["async"]["classes"][i]
+        t = results["threaded"]["classes"][i]
+        if a != t:
+            divergences.append(
+                {
+                    "index": i,
+                    "family": name,
+                    "async": a,
+                    "threaded": t,
+                    "payload": repr(payload[:160]),
+                }
+            )
+    if divergences:
+        return _fail(
+            "taxonomy_divergence",
+            count=len(divergences),
+            first=divergences[:5],
+        )
+
+    compared = sum(1 for c in corpus if c[2])
+    fam_hist = {}
+    for name, _, _, _ in corpus:
+        fam_hist[name] = fam_hist.get(name, 0) + 1
+    print(
+        json.dumps(
+            {
+                "ingest_fuzz": "PASS",
+                "seed": args.seed,
+                "iters": args.iters,
+                "compared": compared,
+                "families": fam_hist,
+                "wall_s": round(time.monotonic() - t0, 1),
+                "governor_async": results["async"]["governor"],
+                "governor_threaded": results["threaded"]["governor"],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,6 +265,115 @@ def test_body_limit_reject_413_parity():
     assert results["async"][2][0] == 200
 
 
+# -- chunked + oversized body parity (ISSUE 11 satellite 3) -------------------
+
+
+FORM = b"Content-Type: application/x-www-form-urlencoded\r\n"
+
+
+def _chunked_payload(chunks, tail=b"0\r\n\r\n", headers=b""):
+    wire = b"".join(b"%x\r\n%s\r\n" % (len(c), c) for c in chunks)
+    return (
+        b"POST /submit HTTP/1.1\r\nHost: t\r\n" + headers
+        + b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        + wire + tail
+    )
+
+
+def _raw_eof(port, payload: bytes, timeout=30):
+    """Send raw bytes, half-close the write side (so truncated framings
+    reach EOF), read one response."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(payload)
+        s.shutdown(socket.SHUT_WR)
+        return _read_response(s.makefile("rb"))
+    finally:
+        s.close()
+
+
+def _both(engine, payload, **kw):
+    """One payload against both frontends; returns {frontend: (status, action)}."""
+    out = {}
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend=frontend, **kw)
+        sc.start()
+        try:
+            assert _wait(sc.ready)
+            resp = _raw_eof(sc.port, payload)
+            assert resp is not None, frontend
+            out[frontend] = (resp[0], resp[1].get("x-waf-action"))
+        finally:
+            sc.stop()
+    assert out["async"] == out["threaded"], out
+    return out
+
+
+def test_chunked_clean_and_attack_verdict_parity(engine):
+    clean = _both(engine, _chunked_payload([b"pet=dog"], headers=FORM))
+    assert clean["async"][0] == 200
+    attack = _both(engine, _chunked_payload([b"pet=evil", b"monkey"], headers=FORM))
+    assert attack["async"][0] == 403
+
+
+def test_chunked_malformed_size_line_parity(engine):
+    # An unparsable chunk-size line stops decoding; both frontends
+    # evaluate what arrived and close after answering.
+    out = _both(
+        engine,
+        _chunked_payload([b"pet=evilmonkey"], tail=b"zz\r\n", headers=FORM),
+    )
+    assert out["async"][0] == 403
+
+
+def test_chunked_truncated_mid_chunk_parity(engine):
+    # Chunk declares 64 bytes, the client sends 14 then closes: both
+    # frontends evaluate the partial bytes (threaded rfile.read()
+    # semantics) instead of hanging or dropping the connection.
+    payload = _chunked_payload([], tail=b"40\r\npet=evilmonkey", headers=FORM)
+    out = _both(engine, payload)
+    assert out["async"][0] == 403
+
+
+def test_chunked_oversized_streaming_413_parity(engine):
+    # The declared chunk size alone trips the ceiling — no body bytes
+    # are ever sent, so the 413 proves streaming (not post-hoc)
+    # enforcement.
+    payload = _chunked_payload([], tail=b"100\r\n")
+    out = _both(engine, payload, max_body_bytes=64)
+    assert out["async"][0] == 413
+
+
+def test_oversized_content_length_413_parity(engine):
+    payload = (
+        b"POST /submit HTTP/1.1\r\nHost: t\r\nContent-Length: 100\r\n"
+        b"Connection: close\r\n\r\n"
+    )
+    out = _both(engine, payload, max_body_bytes=64)
+    assert out["async"][0] == 413
+
+
+def test_bad_content_length_400_parity(engine):
+    payload = (
+        b"POST /submit HTTP/1.1\r\nHost: t\r\nContent-Length: abc\r\n"
+        b"Connection: close\r\n\r\n"
+    )
+    out = _both(engine, payload)
+    assert out["async"][0] == 400
+
+
+def test_truncated_content_length_body_evaluates_partial_parity(engine):
+    # Content-Length promises 100 bytes; 14 arrive before EOF. Both
+    # frontends evaluate the partial body — the attack token must not
+    # slip through by under-delivering the declared length.
+    payload = (
+        b"POST /submit HTTP/1.1\r\nHost: t\r\n" + FORM
+        + b"Content-Length: 100\r\nConnection: close\r\n\r\npet=evilmonkey"
+    )
+    out = _both(engine, payload)
+    assert out["async"][0] == 403
+
+
 # -- deadline + shedding ------------------------------------------------------
 
 
