@@ -64,8 +64,12 @@ ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontend
 	$(PYTHON) hack/ingest_fuzz.py
 
 .PHONY: chaos.smoke
-chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm.
+chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm, crash-restart, device loss.
 	$(PYTHON) hack/chaos_smoke.py
+
+.PHONY: restart.smoke
+restart.smoke:  ## Crash-safe warm restart across a real process boundary: SIGKILL, restore under cache outage, bit-identical verdicts.
+	$(PYTHON) hack/restart_smoke.py
 
 .PHONY: compile.smoke
 compile.smoke:  ## Cold-compile ceiling gate: crs-lite wall + minimized-state + signature caps.

@@ -133,6 +133,24 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         " cko_ingest_aborted_total)",
     )
     p.add_argument(
+        "--state-dir",
+        default=None,
+        help="durable serving-state directory (default $CKO_STATE_DIR;"
+        " empty disables): the serving ruleset, last-known-good ring, and"
+        " rollout latches persist here on every promote/swap/rollback,"
+        " and a restart restores them before the first cache poll"
+        " (docs/RECOVERY.md)",
+    )
+    p.add_argument(
+        "--drain-budget-seconds",
+        type=float,
+        default=None,
+        help="graceful-termination budget (default $CKO_DRAIN_BUDGET_S or"
+        " 10): SIGTERM flips readyz to 503 immediately, then in-flight"
+        " and queued windows drain to real verdicts within this budget"
+        " before the process exits",
+    )
+    p.add_argument(
         "--max-connections",
         type=int,
         default=None,
@@ -252,6 +270,8 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         shadow_promote_windows=args.shadow_promote_windows,
         shadow_sample_rate=args.shadow_sample_rate,
         drain_timeout_s=args.drain_timeout_seconds,
+        state_dir=args.state_dir,
+        drain_budget_s=args.drain_budget_seconds,
         max_connections=args.max_connections,
         header_timeout_s=args.header_timeout_seconds,
         idle_timeout_s=args.idle_timeout_seconds,
@@ -272,6 +292,11 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
 
     def on_signal(_signum, _frame):
+        # Graceful termination (docs/RECOVERY.md): readyz flips to 503
+        # immediately — Kubernetes stops routing while the preStop sleep
+        # and endpoint propagation run — then the main thread drains and
+        # persists state via sidecar.stop().
+        sidecar.begin_drain()
         stop.set()
 
     signal.signal(signal.SIGINT, on_signal)
@@ -280,7 +305,15 @@ def main(argv: list[str] | None = None) -> int:
     log.info("serving", port=sidecar.port)
     stop.wait()
     sidecar.stop()
-    return 0
+    # The drain is complete and the state snapshot is on disk. Exit
+    # decisively: letting the interpreter unwind races XLA's static
+    # destructors against its own daemon threads, which can abort
+    # (SIGABRT) a process whose drain was perfectly clean — and a
+    # restart-loop accounting in Kubernetes is exactly the wrong record
+    # of a graceful termination.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

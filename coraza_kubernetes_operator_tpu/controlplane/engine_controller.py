@@ -31,6 +31,15 @@ log = get_logger("controller.engine")
 
 WASM_PLUGIN_NAME_PREFIX = "coraza-engine-"
 TPU_ENGINE_NAME_PREFIX = "coraza-tpu-engine-"
+# Graceful-termination sizing (docs/RECOVERY.md): SIGTERM flips readyz to
+# 503 immediately; the preStop sleep covers endpoint-removal propagation
+# (new traffic stops arriving BEFORE the process starts draining), the
+# drain budget bounds in-flight/queued window evaluation, and the pod
+# grace period must cover both plus state-persist margin — otherwise the
+# kubelet's SIGKILL lands mid-drain and verdicts are lost.
+TPU_ENGINE_PRESTOP_SLEEP_SECONDS = 5
+TPU_ENGINE_DRAIN_BUDGET_SECONDS = 10
+TPU_ENGINE_TERMINATION_GRACE_SECONDS = 30
 
 
 @dataclass
@@ -189,6 +198,7 @@ class EngineReconciler:
             f"--failure-policy={engine.spec.failure_policy}",
             f"--max-batch-size={tpu.max_batch_size}",
             f"--max-batch-delay-ms={tpu.max_batch_delay_ms}",
+            f"--drain-budget-seconds={TPU_ENGINE_DRAIN_BUDGET_SECONDS}",
             "--audit-log=-",  # SecAuditLog /dev/stdout parity; pod logs
         ]  # carry the audit stream the conformance runner matches against
         return Unstructured(
@@ -214,6 +224,13 @@ class EngineReconciler:
                 "template": {
                     "metadata": {"labels": {"app": name}},
                     "spec": {
+                        # Must cover preStop + drain budget + persist
+                        # margin; the kubelet default (30) only happens to
+                        # match — pin it so a default change elsewhere
+                        # cannot silently truncate the drain.
+                        "terminationGracePeriodSeconds": (
+                            TPU_ENGINE_TERMINATION_GRACE_SECONDS
+                        ),
                         "containers": [
                             {
                                 "name": "tpu-engine",
@@ -242,6 +259,21 @@ class EngineReconciler:
                                 },
                                 "resources": {
                                     "limits": {"google.com/tpu": "1"},
+                                },
+                                # Endpoint removal propagates while the
+                                # pod sleeps; SIGTERM (and the sidecar's
+                                # readyz 503 + drain) comes after.
+                                "lifecycle": {
+                                    "preStop": {
+                                        "exec": {
+                                            "command": [
+                                                "sleep",
+                                                str(
+                                                    TPU_ENGINE_PRESTOP_SLEEP_SECONDS
+                                                ),
+                                            ]
+                                        }
+                                    }
                                 },
                             }
                         ]

@@ -533,6 +533,17 @@ class WafEngine:
     def native_enabled(self) -> bool:
         return self._native.available
 
+    def reinit_device(self) -> None:
+        """Re-put the model's device arrays on a fresh backend after a
+        device loss (docs/RECOVERY.md): the compiled IR is host state and
+        survives, but every ``jnp`` array inside ``self.model`` lived on
+        the dead device. Rebuild them and demote ``warmed`` so the next
+        device batch re-proves the path before promotion — executables
+        are re-fetched from the process/persistent compile caches, so the
+        re-put costs array transfers, not XLA compiles."""
+        self.model = build_model(self.compiled)
+        self.warmed = False
+
     @property
     def host_fallback(self):
         """The no-JAX host evaluator over this engine's compiled ruleset

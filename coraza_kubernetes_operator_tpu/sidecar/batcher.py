@@ -222,6 +222,21 @@ class MicroBatcher:
         # sidecar wires it to "a rollout is actively shadowing this
         # engine", which is the only consumer.
         self.window_wanted = None  # (engine,) -> bool
+        # Graceful-drain hook (docs/RECOVERY.md): at stop(), windows that
+        # were accepted but never dispatched are EVALUATED through this —
+        # (engine, requests) -> list[Verdict] — instead of failed. The
+        # sidecar wires it to the degraded manager's host-fallback
+        # evaluator, so a drain loses no verdict even when the device
+        # path is already gone. Unset, the drain falls back to the
+        # engine's own host evaluator; with no engine at all, items still
+        # fail with EngineUnavailable as before.
+        self.drain_evaluate = None
+        # Wall budget for evaluating those leftovers (the sidecar sizes
+        # it from CKO_DRAIN_BUDGET_S); items past the deadline fail.
+        self.drain_budget_s = 5.0
+        self.drained_requests = 0
+        self.drain_failed = 0
+        self._drain_deadline_t: float | None = None
         # Requests inside queued-but-not-dispatched blob windows; the
         # admission-control signal must count them (a blob window is one
         # queue item but n_req requests of backlog).
@@ -267,6 +282,9 @@ class MicroBatcher:
         then — stop() stays bounded, and the straggler window still
         collects (in the background) instead of abandoning its futures
         behind an early sentinel."""
+        # One wall deadline for the whole drain: queued windows are
+        # evaluated (host fallback) until it passes, then fail fast.
+        self._drain_deadline_t = time.monotonic() + max(0.0, self.drain_budget_s)
         self._running = False
         self._queue.put(None)
         t = self._thread
@@ -289,22 +307,93 @@ class MicroBatcher:
         self._drain_pending()
 
     def _drain_pending(self) -> None:
-        """Fail any futures still queued at shutdown instead of abandoning
-        them — handler threads would otherwise block the full request
-        timeout."""
-        err = EngineUnavailable("batcher stopped")
+        """Resolve any futures still queued at shutdown instead of
+        abandoning them. Accepted windows are EVALUATED within the drain
+        budget (host fallback when the device path is gone) — a graceful
+        drain loses no verdict; only items past the deadline, or with no
+        engine to answer them, fail with ``EngineUnavailable``."""
         while True:
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if isinstance(item, _BlobWindow):
-                with self._inflight_lock:
-                    self._blob_pending -= item.n_req
-                    self._blob_pending_bytes -= len(item.blob)
-                _resolve(item.fut.set_exception, err)
-            elif item is not None:
-                _resolve(item[2].set_exception, err)
+            self._drain_item(item)
+
+    # -- graceful drain (docs/RECOVERY.md) -----------------------------------
+
+    def _drain_deadline(self) -> float:
+        t = self._drain_deadline_t
+        if t is None:
+            t = time.monotonic() + max(0.0, self.drain_budget_s)
+            self._drain_deadline_t = t
+        return t
+
+    def _drain_eval(self, requests, tenant=None):
+        """Evaluate drained requests off the device path; None on any
+        failure (the caller then fails the future the legacy way)."""
+        if time.monotonic() >= self._drain_deadline():
+            return None
+        try:
+            engine = self._engine_fn(tenant)
+            if engine is None:
+                return None
+            if self.drain_evaluate is not None:
+                verdicts = self.drain_evaluate(engine, requests)
+            else:
+                fallback = getattr(engine, "host_fallback", None)
+                if fallback is not None:
+                    verdicts = fallback.evaluate(requests)
+                else:
+                    verdicts = engine.evaluate(requests)
+        except Exception as err:
+            log.error("drain evaluation failed", err, batch=len(requests))
+            return None
+        return verdicts if len(verdicts) == len(requests) else None
+
+    def _drain_item(self, item) -> None:
+        """Resolve one still-queued submit-queue item at shutdown (owns
+        the blob-backlog accounting for queue-popped items)."""
+        if item is None:
+            return
+        if isinstance(item, _BlobWindow):
+            with self._inflight_lock:
+                self._blob_pending -= item.n_req
+                self._blob_pending_bytes -= len(item.blob)
+            self._drain_blob(item)
+        else:
+            self._drain_triple(item)
+
+    def _drain_blob(self, bw: _BlobWindow) -> None:
+        if bw.fut.cancelled():
+            return
+        verdicts = None
+        try:
+            from ..native import blob_requests
+
+            reqs = blob_requests(bw.blob, bw.n_req)
+        except Exception as err:
+            log.error("drain blob materialization failed", err)
+            reqs = None
+        if reqs is not None:
+            verdicts = self._drain_eval(reqs)
+        if verdicts is not None:
+            self.drained_requests += bw.n_req
+            _resolve(bw.fut.set_result, list(verdicts))
+        else:
+            self.drain_failed += bw.n_req
+            _resolve(bw.fut.set_exception, EngineUnavailable("batcher stopped"))
+
+    def _drain_triple(self, item) -> None:
+        req, tenant, fut = item
+        if fut.cancelled():
+            return
+        verdicts = self._drain_eval([req], tenant)
+        if verdicts is not None:
+            self.drained_requests += 1
+            _resolve(fut.set_result, verdicts[0])
+        else:
+            self.drain_failed += 1
+            _resolve(fut.set_exception, EngineUnavailable("batcher stopped"))
 
     def submit(self, request: HttpRequest, tenant: str | None = None) -> Future:
         """Enqueue one request; the Future resolves to its Verdict."""
@@ -357,14 +446,9 @@ class MicroBatcher:
             if item is None:
                 continue
             if not self._running:
-                err = EngineUnavailable("batcher stopped")
-                if isinstance(item, _BlobWindow):
-                    with self._inflight_lock:
-                        self._blob_pending -= item.n_req
-                        self._blob_pending_bytes -= len(item.blob)
-                    _resolve(item.fut.set_exception, err)
-                else:
-                    _resolve(item[2].set_exception, err)
+                # Shutdown drain: the accepted item still gets a verdict
+                # (host fallback) within the drain budget.
+                self._drain_item(item)
                 continue
             with self._inflight_lock:
                 self._window_open = True
@@ -406,12 +490,14 @@ class MicroBatcher:
         control sheds), then dispatch."""
         while not self._depth_sem.acquire(timeout=0.1):
             if not self._running:
-                err = EngineUnavailable("batcher stopped")
+                # Shutdown with the pipeline full: drain the assembled
+                # window off-device instead of failing it. (Blob-backlog
+                # accounting already ran when the item left the queue.)
                 if isinstance(window, _BlobWindow):
-                    _resolve(window.fut.set_exception, err)
+                    self._drain_blob(window)
                 else:
-                    for _req, _tenant, fut in window:
-                        _resolve(fut.set_exception, err)
+                    for triple in window:
+                        self._drain_triple(triple)
                 return
         with self._inflight_lock:
             self._inflight_count += 1
@@ -572,6 +658,25 @@ class MicroBatcher:
                     _resolve(record.window[i][2].set_exception, g.error)
                 continue
             self._notify(self.on_engine_success, g.engine)
+            # One stats sample per model group, recorded BEFORE the
+            # futures resolve: a caller that reads /stats right after its
+            # verdict lands must see its own request counted. Each group
+            # is its own device step, so waf_batch_step_seconds /
+            # waf_batch_size keep measuring a single device batch even in
+            # multi-tenant windows. Latency spans dispatch start ->
+            # collect end: the true window residency a caller observes
+            # under pipelining.
+            try:
+                self.stats.record(len(g.idxs), time.monotonic() - g.t_dispatch)
+                inflight = g.inflight
+                if inflight is not None:
+                    self.stats.record_stage(
+                        getattr(inflight, "host_s", 0.0),
+                        getattr(inflight, "device_s", 0.0)
+                        + getattr(inflight, "decode_s", 0.0),
+                    )
+            except Exception as err:  # metrics hooks must not fail verdicts
+                log.error("batch stats hook failed", err)
             for i, verdict in zip(g.idxs, g.verdicts):
                 _resolve(record.window[i][2].set_result, verdict)
             if self.on_window is not None:
@@ -590,22 +695,6 @@ class MicroBatcher:
                     list(g.verdicts),
                     serving_s,
                 )
-            # One stats sample per model group: each group is its own
-            # device step, so waf_batch_step_seconds / waf_batch_size keep
-            # measuring a single device batch even in multi-tenant
-            # windows. Latency spans dispatch start -> collect end: the
-            # true window residency a caller observes under pipelining.
-            try:
-                self.stats.record(len(g.idxs), time.monotonic() - g.t_dispatch)
-                inflight = g.inflight
-                if inflight is not None:
-                    self.stats.record_stage(
-                        getattr(inflight, "host_s", 0.0),
-                        getattr(inflight, "device_s", 0.0)
-                        + getattr(inflight, "decode_s", 0.0),
-                    )
-            except Exception as err:  # metrics hooks must not fail verdicts
-                log.error("batch stats hook failed", err)
 
     def _collect_blob(self, record: _WindowRecord) -> None:
         """Collect one blob window: resolve its single future with the
@@ -635,6 +724,18 @@ class MicroBatcher:
             if inflight is not None
             else time.monotonic() - g.t_dispatch
         )
+        # Account BEFORE resolving: a caller that reads /stats right
+        # after its verdict lands must see its own window counted.
+        try:
+            self.stats.record(bw.n_req, time.monotonic() - g.t_dispatch)
+            if inflight is not None:
+                self.stats.record_stage(
+                    getattr(inflight, "host_s", 0.0),
+                    getattr(inflight, "device_s", 0.0)
+                    + getattr(inflight, "decode_s", 0.0),
+                )
+        except Exception as err:  # metrics hooks must not fail verdicts
+            log.error("batch stats hook failed", err)
         _resolve(bw.fut.set_result, list(g.verdicts))
         if self.on_window is not None and (
             self.window_wanted is None or self._wants_window(g.engine)
@@ -650,16 +751,6 @@ class MicroBatcher:
                 self._notify(
                     self.on_window, g.engine, reqs, list(g.verdicts), serving_s
                 )
-        try:
-            self.stats.record(bw.n_req, time.monotonic() - g.t_dispatch)
-            if inflight is not None:
-                self.stats.record_stage(
-                    getattr(inflight, "host_s", 0.0),
-                    getattr(inflight, "device_s", 0.0)
-                    + getattr(inflight, "decode_s", 0.0),
-                )
-        except Exception as err:  # metrics hooks must not fail verdicts
-            log.error("batch stats hook failed", err)
 
     def _wants_window(self, engine) -> bool:
         try:

@@ -42,6 +42,7 @@ then warms the new engine exactly as it always has.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -50,6 +51,216 @@ from ..engine.waf import warmup_request
 from ..utils import get_logger
 
 log = get_logger("sidecar.degraded")
+
+# Device-loss recovery states (docs/RECOVERY.md). Distinct from the
+# transient breaker: a lost device needs its arrays RE-PUT on a fresh
+# backend, not a cooldown-and-retry.
+DEVICE_OK = "ok"
+DEVICE_REINIT = "reinit"
+DEVICE_EXHAUSTED = "exhausted"
+
+# Knobs (None config fields read these at construction):
+DEVICE_LOST_THRESHOLD_ENV = "CKO_DEVICE_LOST_THRESHOLD"  # default 5
+DEVICE_REINIT_ATTEMPTS_ENV = "CKO_DEVICE_REINIT_ATTEMPTS"  # default 3
+DEVICE_REINIT_BACKOFF_ENV = "CKO_DEVICE_REINIT_BACKOFF_S"  # default 0.5
+
+# Substrings that mark an error as device-LOSS class (the backend is
+# gone) rather than a transient kernel fault. XLA surfaces these as
+# XlaRuntimeError text; the fault harness raises DeviceLostFault.
+_DEVICE_LOSS_MARKERS = (
+    "device_lost",
+    "device lost",
+    "device unavailable",
+    "device disappeared",
+)
+
+
+def is_device_loss(err: BaseException) -> bool:
+    """True when ``err`` is a device-loss-class failure: the injected
+    :class:`~..testing.faults.DeviceLostFault`, or an XLA runtime error
+    whose text carries a device-loss marker."""
+    from ..testing.faults import DeviceLostFault
+
+    if isinstance(err, DeviceLostFault):
+        return True
+    text = f"{type(err).__name__}: {err}".lower()
+    return any(marker in text for marker in _DEVICE_LOSS_MARKERS)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class DeviceLossManager:
+    """Persistent device-loss handling, distinct from the circuit breaker.
+
+    The breaker guards against TRANSIENT device faults: open, cool down,
+    half-open probe the same arrays. A device LOSS (TPU runtime restart,
+    ``DEVICE_LOST`` class errors) invalidates every array the engines
+    hold — probing them forever can never recover. This manager declares
+    a loss on one device-loss-class error or ``threshold`` consecutive
+    device errors of any kind, then runs a bounded, backed-off re-init
+    loop: re-put every resident engine's model arrays on a fresh backend
+    (``WafEngine.reinit_device``) and prove the path with the canonical
+    canary through the same prepare/collect split the batcher serves on.
+
+    While re-init runs, serving mode is ``fallback`` (host evaluator —
+    no verdict is ever lost; the window that observed the loss is
+    re-answered by the server's existing fallback rescue). Only when
+    every attempt is exhausted does the mode escalate to ``broken``
+    (readyz 503, replica out of rotation). Success closes the breaker
+    and resumes device serving through normal promotion.
+    """
+
+    def __init__(
+        self,
+        engines_fn,
+        threshold: int | None = None,
+        max_attempts: int | None = None,
+        backoff_s: float | None = None,
+        on_lost=None,
+        on_recovered=None,
+    ):
+        # engines_fn() -> iterable of CURRENT resident engines (the
+        # sidecar supplies distinct serving engines across tenants).
+        self._engines_fn = engines_fn
+        if threshold is None:
+            threshold = int(_env_float(DEVICE_LOST_THRESHOLD_ENV, 5))
+        if max_attempts is None:
+            max_attempts = int(_env_float(DEVICE_REINIT_ATTEMPTS_ENV, 3))
+        if backoff_s is None:
+            backoff_s = _env_float(DEVICE_REINIT_BACKOFF_ENV, 0.5)
+        self.threshold = max(1, threshold)
+        self.max_attempts = max(1, max_attempts)
+        self.backoff_s = max(0.05, backoff_s)
+        self._on_lost = on_lost  # () -> None, e.g. cko_device_lost_total.inc
+        self._on_recovered = on_recovered  # () -> None, e.g. breaker close
+        self._lock = threading.Lock()
+        self._state = DEVICE_OK
+        self._consecutive = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.losses_total = 0
+        self.reinit_attempts = 0
+        self.reinit_failures = 0
+        self.recoveries = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def note_error(self, err: BaseException) -> bool:
+        """Feed one device-path failure. Returns True when the error is
+        OWNED by the device-loss path (device-loss class — the transient
+        breaker must not also count it); generic errors return False and
+        keep feeding the breaker while still counting toward the
+        consecutive-loss threshold."""
+        lost = is_device_loss(err)
+        begin = False
+        with self._lock:
+            if self._state != DEVICE_OK:
+                return lost  # a re-init (or exhaustion) is already active
+            self._consecutive += 1
+            if lost or self._consecutive >= self.threshold:
+                self._state = DEVICE_REINIT
+                self.losses_total += 1
+                begin = True
+        if begin:
+            log.critical(
+                "device LOSS declared: re-putting arrays on a fresh backend",
+                err,
+                loss_class=lost,
+                consecutive=self._consecutive,
+                max_attempts=self.max_attempts,
+            )
+            if self._on_lost is not None:
+                try:
+                    self._on_lost()
+                except Exception as hook_err:
+                    log.error("device-loss hook failed", hook_err)
+            self._thread = threading.Thread(
+                target=self._reinit_loop, name="cko-device-reinit", daemon=True
+            )
+            self._thread.start()
+        return lost
+
+    def note_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+
+    # -- recovery loop -------------------------------------------------------
+
+    def _reinit_loop(self) -> None:
+        backoff = self.backoff_s
+        for attempt in range(1, self.max_attempts + 1):
+            if self._stop.wait(backoff if attempt > 1 else 0.0):
+                return
+            backoff = min(backoff * 2, 30.0)
+            self.reinit_attempts += 1
+            try:
+                engines = [e for e in self._engines_fn() if e is not None]
+                for engine in engines:
+                    reinit = getattr(engine, "reinit_device", None)
+                    if reinit is not None:
+                        reinit()
+                # Prove the exact serving path per engine: the canary
+                # through prepare/collect (stub engines via evaluate).
+                for engine in engines:
+                    prepare = getattr(engine, "prepare", None)
+                    if prepare is not None:
+                        engine.collect(prepare([_canary_request()]))
+                    else:
+                        engine.evaluate([_canary_request()])
+            except Exception as err:
+                self.reinit_failures += 1
+                log.error(
+                    "device re-init attempt failed",
+                    err,
+                    attempt=attempt,
+                    max_attempts=self.max_attempts,
+                )
+                continue
+            with self._lock:
+                self._state = DEVICE_OK
+                self._consecutive = 0
+                self.recoveries += 1
+            log.info("device path recovered after loss", attempts=attempt)
+            if self._on_recovered is not None:
+                try:
+                    self._on_recovered()
+                except Exception as hook_err:
+                    log.error("device-recovered hook failed", hook_err)
+            return
+        with self._lock:
+            self._state = DEVICE_EXHAUSTED
+        log.critical(
+            "device re-init EXHAUSTED: serving mode escalates to broken",
+            attempts=self.max_attempts,
+        )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_errors": self._consecutive,
+                "threshold": self.threshold,
+                "max_attempts": self.max_attempts,
+                "losses_total": self.losses_total,
+                "reinit_attempts": self.reinit_attempts,
+                "reinit_failures": self.reinit_failures,
+                "recoveries": self.recoveries,
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+
 
 MODE_COLD = "cold"
 MODE_FALLBACK = "fallback"
@@ -172,6 +383,10 @@ class DegradedModeManager:
         # retrying forever (and feeding the breaker) on behalf of an
         # engine nothing serves anymore.
         self._is_current = is_current
+        # Optional DeviceLossManager (docs/RECOVERY.md), wired by the
+        # sidecar after construction. When set, it classifies device
+        # errors ahead of the breaker and owns the re-init recovery.
+        self.device_loss: DeviceLossManager | None = None
         self._lock = threading.Lock()
         self._probing: set[int] = set()
         self._stop = threading.Event()
@@ -183,6 +398,19 @@ class DegradedModeManager:
     def mode_for(self, engine) -> str:
         if engine is None:
             return MODE_COLD
+        dl = self.device_loss
+        if dl is not None:
+            dl_state = dl.state
+            if dl_state == DEVICE_EXHAUSTED:
+                return MODE_BROKEN
+            if dl_state == DEVICE_REINIT and self.breaker.state == BREAKER_CLOSED:
+                # Device loss under active re-init: serve from the host
+                # fallback (readyz stays green — no verdict is lost) and
+                # escalate to broken only on re-init exhaustion. Loss-class
+                # errors bypass the breaker, so it is closed on the pure
+                # device-loss path; a generic-error storm that already
+                # opened the breaker keeps reading ``broken`` below.
+                return MODE_FALLBACK if self.fallback_enabled else MODE_BROKEN
         if self.breaker.state != BREAKER_CLOSED:
             return MODE_BROKEN
         return MODE_PROMOTED if getattr(engine, "warmed", False) else MODE_FALLBACK
@@ -219,6 +447,12 @@ class DegradedModeManager:
     # -- breaker feed --------------------------------------------------------
 
     def record_device_failure(self, err: BaseException) -> None:
+        dl = self.device_loss
+        if dl is not None and dl.note_error(err):
+            # Device-loss-class error: owned by the re-init state machine;
+            # the transient breaker must not also count it (its half-open
+            # probes can never revive arrays whose backend is gone).
+            return
         opened = self.breaker.record_failure()
         if opened:
             # CRITICAL: the data plane lost its device path. Serving
@@ -231,6 +465,8 @@ class DegradedModeManager:
             )
 
     def record_device_success(self) -> None:
+        if self.device_loss is not None:
+            self.device_loss.note_success()
         self.breaker.record_success()
 
     # -- promotion / half-open probe ----------------------------------------
@@ -239,6 +475,12 @@ class DegradedModeManager:
         """Start (at most one) background thread that proves the engine's
         device path: the first successful batch both warms the engine
         (promotion) and closes the breaker."""
+        dl = self.device_loss
+        if dl is not None and dl.state == DEVICE_REINIT:
+            # The device-loss re-init loop owns recovery: its canary
+            # proves a FRESH backend; probing the stale arrays here would
+            # only feed noise into the breaker.
+            return
         key = id(engine)
         with self._lock:
             if key in self._probing:
@@ -314,13 +556,18 @@ class DegradedModeManager:
     def stats(self) -> dict:
         with self._lock:
             probing = len(self._probing)
-        return {
+        out = {
             "fallback_enabled": self.fallback_enabled,
             "fallback_requests": self.fallback_requests,
             "promotions": self.promotions,
             "probing": probing,
             "breaker": self.breaker.snapshot(),
         }
+        if self.device_loss is not None:
+            out["device_loss"] = self.device_loss.stats()
+        return out
 
     def stop(self) -> None:
         self._stop.set()
+        if self.device_loss is not None:
+            self.device_loss.stop()

@@ -403,19 +403,29 @@ class AsyncIngestFrontend:
         except RuntimeError:
             pass
         if self._thread is not None:
-            drain_s = getattr(self.sidecar.config, "drain_timeout_s", 2.0)
+            drain_s = self._drain_budget_s()
             self._thread.join(timeout=max(10.0, drain_s + 5.0))
         self._eval_pool.shutdown(wait=False)
         self._ctl_pool.shutdown(wait=False)
 
+    def _drain_budget_s(self) -> float:
+        """Shutdown drain budget: drain_timeout_s, widened during a
+        GRACEFUL termination (sidecar.begin_drain) to the process drain
+        budget (docs/RECOVERY.md) — a SIGTERM drains in-flight windows to
+        real verdicts instead of force-closing them at the 2s default."""
+        drain_s = getattr(self.sidecar.config, "drain_timeout_s", 2.0)
+        if getattr(self.sidecar, "draining", False):
+            drain_s = max(drain_s, getattr(self.sidecar, "drain_budget_s", 0.0))
+        return max(0.0, drain_s)
+
     async def _drain(self) -> None:
         """Bounded shutdown drain: dispatched windows get a moment to
         resolve so queued clients see answers instead of resets. The
-        budget is ``SidecarConfig.drain_timeout_s``; connections still
+        budget is ``SidecarConfig.drain_timeout_s`` (widened to the
+        graceful-termination budget while draining); connections still
         open when it expires are force-closed and counted in
         ``cko_ingest_aborted_total``."""
-        drain_s = getattr(self.sidecar.config, "drain_timeout_s", 2.0)
-        deadline = self._loop.time() + max(0.0, drain_s)
+        deadline = self._loop.time() + self._drain_budget_s()
         while self._inflight_windows > 0 and self._loop.time() < deadline:
             await asyncio.sleep(0.02)
         if self.connections > 0:

@@ -86,12 +86,18 @@ class RuleReloader:
         engine_factory=WafEngine,
         on_swap=None,
         rollout: RolloutManager | None = None,
+        on_persist=None,
     ):
         # on_swap(engine): called after every atomic engine swap — the
         # sidecar uses it to kick background device promotion for the
         # fresh engine (degraded-mode serving) without waiting for the
         # first request to route.
         self._on_swap = on_swap
+        # on_persist(): called after every swap/promote/rollback so the
+        # sidecar can write its durable serving-state snapshot
+        # (sidecar/state_store.py) — crash-safe warm restart depends on
+        # the snapshot tracking every serving transition, not a timer.
+        self._on_persist = on_persist
         self.cache_base_url = cache_base_url.rstrip("/")
         self.instance_key = instance_key.strip("/")
         self.poll_interval_s = poll_interval_s
@@ -126,6 +132,16 @@ class RuleReloader:
         self.rollbacks_forced = 0
         self._swap_lock = threading.Lock()
         self._rollout_latched: dict[str, float] = {}
+        # Durable-state support (docs/RECOVERY.md): ruleset TEXT by uuid
+        # for the serving engine, ring entries, and any actively staging
+        # candidate. Text is the durable form of an engine — snapshots
+        # persist it, restore recompiles it (dedup + compile caches make
+        # that cheap). Pruned on every swap. Seeded engines (tests,
+        # static rules) have no text and are simply not persisted.
+        self._text_by_uuid: dict[str, str] = {}
+        # True when this reloader's serving state came from a disk
+        # snapshot rather than a cache poll (the /stats recovery block).
+        self.restored = False
         # Bumped by every forced rollback. A rollout captures the epoch
         # when it stages; its promotion swap is honored only if no forced
         # rollback intervened — closing the race where a candidate wins
@@ -143,11 +159,16 @@ class RuleReloader:
     def current_uuid(self) -> str | None:
         return self._uuid
 
-    def seed(self, engine: WafEngine, uuid: str | None = None) -> None:
+    def seed(
+        self, engine: WafEngine, uuid: str | None = None, rules: str | None = None
+    ) -> None:
         """Install a pre-built engine (static rules / tests) through the same
-        swap invariant the poll path uses."""
+        swap invariant the poll path uses. Passing the ruleset ``rules``
+        text makes the seeded engine durable (snapshot-restorable)."""
         self._engine = engine
         self._uuid = uuid
+        if uuid and rules:
+            self._text_by_uuid[uuid] = rules
         self._loaded_once.set()
 
     def start(self) -> None:
@@ -220,6 +241,7 @@ class RuleReloader:
             log.info("rules fetch failed", key=self.instance_key, error=str(err))
             return False
         rules = entry.get("rules", "")
+        self._remember_text(uuid, rules)
         if mgr is not None and self._engine is not None:
             # A newer version supersedes any in-flight candidate: the
             # operator's latest intent wins; the old candidate is
@@ -337,6 +359,7 @@ class RuleReloader:
             self._engine = engine  # atomic swap; next batch window uses it
             self._uuid = uuid
             self.reloads += 1
+            self._prune_text()
         self._loaded_once.set()
         if self._on_swap is not None:
             try:
@@ -350,6 +373,7 @@ class RuleReloader:
             rules=engine.compiled.n_rules,
             groups=engine.compiled.n_groups,
         )
+        self._persist()
 
     def force_rollback(self) -> dict | None:
         """Operator-forced rollback (``POST /waf/v1/rollback``): abort any
@@ -387,12 +411,134 @@ class RuleReloader:
             rolled_back_from=bad_uuid,
             rolled_back_to=prev_uuid,
         )
+        self._persist()
         return {
             "tenant": self.instance_key,
             "rolled_back_from": bad_uuid,
             "rolled_back_to": prev_uuid,
             "ring_remaining": len(self.ring),
         }
+
+    # -- durable serving state (docs/RECOVERY.md) ----------------------------
+
+    def _remember_text(self, uuid: str | None, rules: str) -> None:
+        # Under _swap_lock: _prune_text (rollout promotion thread) swaps
+        # the dict object; an unlocked write here could land in the old
+        # one and silently vanish from the next snapshot.
+        if uuid and rules:
+            with self._swap_lock:
+                self._text_by_uuid[uuid] = rules
+
+    def _prune_text(self) -> None:
+        """Keep only the texts the snapshot can reference: serving, ring,
+        and any actively staging candidate. Called under ``_swap_lock``."""
+        keep = {u for u in [self._uuid, *self.ring.uuids()] if u}
+        if self._rollout_mgr is not None:
+            active = self._rollout_mgr.active(self.instance_key)
+            if active is not None and active.uuid:
+                keep.add(active.uuid)
+        self._text_by_uuid = {
+            u: t for u, t in self._text_by_uuid.items() if u in keep
+        }
+
+    def _persist(self) -> None:
+        """Kick the sidecar's snapshot write; a failing persist hook must
+        never break the swap that triggered it."""
+        if self._on_persist is None:
+            return
+        try:
+            self._on_persist()
+        except Exception as err:
+            log.error("on_persist hook failed", err)
+
+    def snapshot(self) -> dict | None:
+        """Durable view of this tenant's serving state: ruleset TEXT for
+        the serving engine and every ring entry, plus the rollout latches
+        and the analysis-rejected uuid. Returns None when nothing
+        persistable is serving (no engine, or a seeded engine whose text
+        was never provided)."""
+        with self._swap_lock:
+            if self._engine is None or not self._uuid:
+                return None
+            rules = self._text_by_uuid.get(self._uuid)
+            if not rules:
+                return None
+            ring = []
+            for ring_uuid in self.ring.uuids():  # oldest -> newest
+                text = self._text_by_uuid.get(ring_uuid or "")
+                if ring_uuid and text:
+                    ring.append({"uuid": ring_uuid, "rules": text})
+            return {
+                "uuid": self._uuid,
+                "rules": rules,
+                "ring": ring,
+                "latched": sorted(self._rollout_latched),
+                "rejected_uuid": self._rejected_uuid,
+            }
+
+    def restore(self, snap: dict) -> bool:
+        """Rebuild serving state from a disk snapshot BEFORE the first
+        cache poll: compile the serving ruleset (and the LKG ring's
+        entries, oldest first, so ``POST /waf/v1/rollback`` behaves
+        identically after a restart), re-run the analysis baseline, and
+        re-latch failed-rollout uuids. Returns False on any failure —
+        the caller then cold-starts through the normal poll path. The
+        restored uuid reconciles against the next successful poll for
+        free: ``poll_once`` short-circuits on an unchanged uuid and
+        stages anything newer through the standard rollout pipeline."""
+        uuid = snap.get("uuid")
+        rules = snap.get("rules")
+        if not uuid or not isinstance(rules, str) or not rules:
+            return False
+        try:
+            engine = self._engine_factory(rules)
+        except Exception as err:
+            log.error("snapshot restore compile failed", err, uuid=uuid)
+            return False
+        report = self._analyze(rules, engine)
+        ring_entries = []
+        for entry in snap.get("ring") or []:
+            if not isinstance(entry, dict):
+                continue
+            ring_uuid, text = entry.get("uuid"), entry.get("rules")
+            if not ring_uuid or not isinstance(text, str) or not text:
+                continue
+            try:
+                ring_entries.append((ring_uuid, self._engine_factory(text), text))
+            except Exception as err:
+                # A ring entry that no longer compiles shrinks the ring;
+                # it must not block restoring the serving engine.
+                log.error("ring entry restore failed", err, uuid=ring_uuid)
+        with self._swap_lock:
+            for ring_uuid, ring_engine, text in ring_entries:
+                self.ring.push(ring_uuid, ring_engine)
+                self._text_by_uuid[ring_uuid] = text
+            self._engine = engine
+            self._uuid = uuid
+            self._text_by_uuid[uuid] = rules
+            if report is not None:
+                self.analysis = report
+            rejected = snap.get("rejected_uuid")
+            self._rejected_uuid = rejected if isinstance(rejected, str) else None
+            now = time.monotonic()
+            for latched in snap.get("latched") or []:
+                if isinstance(latched, str) and latched:
+                    self._rollout_latched[latched] = now
+            self.restored = True
+        self._loaded_once.set()
+        if self._on_swap is not None:
+            try:
+                self._on_swap(engine)  # kick background device promotion
+            except Exception as err:
+                log.error("on_swap hook failed", err)
+        log.info(
+            "serving state restored from snapshot",
+            key=self.instance_key,
+            uuid=uuid,
+            ring=len(ring_entries),
+            rules=engine.compiled.n_rules,
+        )
+        return True
 
     # -- internals -----------------------------------------------------------
 

@@ -29,6 +29,7 @@ requests through unevaluated.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time as _time
 from concurrent.futures import Future
@@ -56,11 +57,13 @@ from .degraded import (
     BreakerOpen,
     CircuitBreaker,
     DegradedModeManager,
+    DeviceLossManager,
     Overloaded,
 )
 from .governor import BadContentLength, BodyTooLarge, IngressGovernor, MemoryShed
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .rollout import RolloutConfig, RolloutManager
+from .state_store import StateStore
 from .tenants import TENANT_HEADER, TenantManager
 
 log = get_logger("sidecar.server")
@@ -188,6 +191,17 @@ class SidecarConfig:
     # windows to resolve before force-closing remaining connections
     # (force-closes are counted in cko_ingest_aborted_total).
     drain_timeout_s: float = 2.0
+    # -- crash-safe warm restart (docs/RECOVERY.md) --------------------------
+    # Durable serving-state directory: the serving ruleset document, the
+    # last-known-good ring, and rollout latches persist here on every
+    # promote/swap/rollback, and a restart restores them BEFORE the first
+    # cache poll. None reads CKO_STATE_DIR; empty/unset disables.
+    state_dir: str | None = None
+    # Graceful-termination budget: on SIGTERM readyz flips to 503
+    # immediately, then in-flight + queued windows drain within this many
+    # seconds (host fallback when the device path is gone) before the
+    # process exits. None reads CKO_DRAIN_BUDGET_S (default 10).
+    drain_budget_s: float | None = None
     # -- staged ruleset rollout (docs/ROLLOUT.md) ----------------------------
     # Hot reloads stage a candidate in a budgeted background compile,
     # shadow-verify it on mirrored live traffic, and promote only after N
@@ -505,6 +519,21 @@ class TpuEngineSidecar:
 
     def __init__(self, config: SidecarConfig, engine: WafEngine | None = None):
         self.config = config
+        self._start_time = _time.time()
+        # Durable serving state (docs/RECOVERY.md): snapshots land here on
+        # every promote/swap/rollback; boot restores from them before the
+        # first cache poll. Disabled unless state_dir/CKO_STATE_DIR is set.
+        self.state_store = StateStore(config.state_dir)
+        if config.drain_budget_s is not None:
+            self.drain_budget_s = float(config.drain_budget_s)
+        else:
+            try:
+                self.drain_budget_s = float(
+                    os.environ.get("CKO_DRAIN_BUDGET_S", "") or 10.0
+                )
+            except ValueError:
+                self.drain_budget_s = 10.0
+        self._draining = False
         # Ingress governance (docs/SERVING.md "Overload & limits"): ONE
         # governor shared by whichever frontend serves — connection cap,
         # read deadlines, body ceiling, and the in-flight byte ledger are
@@ -547,6 +576,9 @@ class TpuEngineSidecar:
             # constructed below.
             on_swap=lambda engine: self._on_engine_swap(engine),
             rollout=self.rollout,
+            # Persist the serving state on every promote/swap/rollback —
+            # the crash-consistency point for warm restarts.
+            on_persist=self._persist_state,
         )
         if engine is not None:  # pre-seeded (tests / static rules)
             self.tenants.seed(self.tenants.default_tenant, engine)
@@ -643,6 +675,35 @@ class TpuEngineSidecar:
             "Device-path circuit breaker (0 closed, 1 open, 2 half-open)",
         ).set_function(
             lambda: float(BREAKER_CODES[self.degraded.breaker.state])
+        )
+        # -- crash-safe warm restart (docs/RECOVERY.md) ---------------------
+        self.metrics.gauge(
+            "cko_process_start_time_seconds",
+            "Unix time this sidecar process started",
+        ).set_function(lambda: float(self._start_time))
+        self._m_restore_attempts = self.metrics.counter(
+            "cko_restore_attempts_total",
+            "Warm-restart restores attempted from a durable state snapshot",
+        )
+        self._m_restore_success = self.metrics.counter(
+            "cko_restore_success_total",
+            "Warm-restart restores that re-installed a serving engine",
+        )
+        self._m_device_lost = self.metrics.counter(
+            "cko_device_lost_total",
+            "Device losses declared (entries into the re-init state machine)",
+        )
+        self._m_drain = self.metrics.gauge(
+            "cko_drain_seconds", "Wall seconds the last graceful drain took"
+        )
+        # Persistent device-loss recovery, distinct from the transient
+        # breaker: re-put every resident engine's arrays on a fresh
+        # backend with bounded backed-off attempts; escalate to broken
+        # only on exhaustion. Recovery closes the breaker.
+        self.degraded.device_loss = DeviceLossManager(
+            engines_fn=self._resident_engine_objects,
+            on_lost=self._m_device_lost.inc,
+            on_recovered=self.degraded.breaker.record_success,
         )
         # -- shape-canonical executable reuse (engine/compile_cache.py) -----
         # Process-wide AOT executable cache: hits = dispatches (and hot
@@ -776,6 +837,11 @@ class TpuEngineSidecar:
         self.batcher.on_engine_success = (
             lambda _engine: self.degraded.record_device_success()
         )
+        # Graceful drain: windows still queued at stop() are EVALUATED
+        # (host fallback when available) within the drain budget instead
+        # of failing — an accepted request never loses its verdict.
+        self.batcher.drain_budget_s = self.drain_budget_s
+        self.batcher.drain_evaluate = self._drain_evaluate
         self._fb_lock = threading.Lock()
         self._fallback_inflight = 0
         self.batcher.stats.on_batch = self._on_batch
@@ -927,6 +993,77 @@ class TpuEngineSidecar:
         """cold | fallback | promoted | broken (for the given tenant)."""
         return self.degraded.mode_for(self.tenants.engine_for(tenant))
 
+    def _resident_engine_objects(self) -> list:
+        """DISTINCT serving engines across tenants (dedupe by identity —
+        the device-loss re-init must re-put each model's arrays once)."""
+        seen: dict[int, object] = {}
+        for key in self.tenants.tenants:
+            e = self.tenants.engine_for(key)
+            if e is not None:
+                seen[id(e)] = e
+        return list(seen.values())
+
+    def _drain_evaluate(self, engine, requests: list[HttpRequest]) -> list[Verdict]:
+        """Batcher drain hook: answer still-queued windows off-device at
+        shutdown. Host fallback when the engine has one (bit-identical
+        verdicts, works with the device gone); stub engines evaluate
+        directly."""
+        if (
+            self.config.fallback_enabled
+            and getattr(engine, "host_fallback", None) is not None
+        ):
+            return self.degraded.fallback_evaluate(engine, requests)
+        return engine.evaluate(requests)
+
+    # -- crash-safe warm restart (docs/RECOVERY.md) --------------------------
+
+    def _persist_state(self) -> None:
+        """Write the durable serving-state snapshot (no-op when the state
+        store is disabled). Called on every promote/swap/rollback and at
+        the end of a graceful stop; must never fail the caller."""
+        store = self.state_store
+        if not store.enabled:
+            return
+        try:
+            store.save(self.tenants.snapshot())
+        except Exception as err:  # snapshot assembly is the only riser
+            log.error("serving-state persist failed", err)
+
+    def _restore_state(self) -> None:
+        """Restore serving state from the snapshot — BEFORE the first
+        cache poll, so a restart serves in seconds even with the rules
+        cache unreachable. The restored uuid reconciles against the next
+        successful poll through the normal staged-rollout path."""
+        store = self.state_store
+        if not store.enabled:
+            return
+        snap = store.load()
+        if snap is None:
+            return
+        self._m_restore_attempts.inc()
+        try:
+            n = self.tenants.restore(snap)
+        except Exception as err:
+            log.error("serving-state restore failed; cold start", err)
+            return
+        if n > 0:
+            self._m_restore_success.inc()
+            log.info("serving state restored from snapshot", tenants=n)
+
+    def begin_drain(self) -> None:
+        """Graceful-termination entry (SIGTERM): flip readyz to 503
+        immediately so Kubernetes stops routing new traffic, while
+        in-flight and queued windows keep draining; ``stop()`` then
+        finishes within the drain budget."""
+        if self._draining:
+            return
+        self._draining = True
+        log.info("drain begun: readyz now 503", budget_s=self.drain_budget_s)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # -- staged rollout helpers ---------------------------------------------
 
     def force_rollback(self, tenant: str | None = None) -> dict | None:
@@ -965,6 +1102,10 @@ class TpuEngineSidecar:
         return 200, b"ok\n", {"Content-Type": "text/plain"}
 
     def readyz_reply(self) -> tuple[int, bytes, dict]:
+        if self._draining:
+            # Graceful termination: out of rotation immediately; in-flight
+            # work still drains to completion before the process exits.
+            return 503, b"draining\n", {"Content-Type": "text/plain"}
         if not self.ready():
             return (
                 503,
@@ -1554,20 +1695,40 @@ class TpuEngineSidecar:
                 **self.governor.stats(),
                 "window_bytes_pending": self.batcher.pending_bytes(),
             },
+            "recovery": {
+                "process_start_time": self._start_time,
+                "state_store": self.state_store.stats(),
+                "restore_attempts": int(self._m_restore_attempts.value()),
+                "restore_success": int(self._m_restore_success.value()),
+                "restored_tenants": self.tenants.total_restored,
+                "device_lost_total": int(self._m_device_lost.value()),
+                "device_loss": (
+                    self.degraded.device_loss.stats()
+                    if self.degraded.device_loss is not None
+                    else None
+                ),
+                "draining": self._draining,
+                "drain_budget_s": self.drain_budget_s,
+                "drained_requests": self.batcher.drained_requests,
+                "drain_failed": self.batcher.drain_failed,
+            },
         }
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self.batcher.start()
-        self.tenants.start()
-        # Kick promotion for engines already resident (seeded/static):
-        # the first device batch runs in the background while the
-        # fallback path answers traffic.
-        for key in self.tenants.tenants:
-            engine = self.tenants.engine_for(key)
-            if engine is not None:
-                self.degraded.ensure_probe(engine)
+        if self.state_store.enabled:
+            # Warm restart: restore from the snapshot BEFORE the first
+            # cache poll, off the startup path — the HTTP listener (and
+            # its healthz) must come up immediately; readyz flips once an
+            # engine installs. The restored uuid then reconciles against
+            # the next poll through the normal staged-rollout path.
+            threading.Thread(
+                target=self._boot, name="cko-restore", daemon=True
+            ).start()
+        else:
+            self._boot()
         if self._frontend is not None:
             self._frontend.start()
         else:
@@ -1584,9 +1745,28 @@ class TpuEngineSidecar:
             maxBatch=self.config.max_batch_size,
         )
 
+    def _boot(self) -> None:
+        """Restore durable state (snapshot install precedes the first
+        cache poll), then start polling and kick promotion for engines
+        already resident (seeded/restored) — the first device batch runs
+        in the background while the fallback path answers traffic."""
+        try:
+            self._restore_state()
+        except Exception as err:
+            log.error("warm-restart restore failed; cold start", err)
+        self.tenants.start()
+        for key in self.tenants.tenants:
+            engine = self.tenants.engine_for(key)
+            if engine is not None:
+                self.degraded.ensure_probe(engine)
+
     def stop(self) -> None:
-        # Stop accepting connections first, then drain the batcher (which
-        # fails any still-queued futures fast), then the reloader.
+        # Graceful drain (docs/RECOVERY.md): readyz 503 first, then stop
+        # accepting connections, drain the batcher (in-flight windows
+        # collect; still-queued windows evaluate on the host fallback
+        # within the drain budget), persist the serving state, exit.
+        t0 = _time.monotonic()
+        self.begin_drain()
         if self._frontend is not None:
             self._frontend.stop()
         else:
@@ -1599,6 +1779,9 @@ class TpuEngineSidecar:
             self.rollout.stop()
         self.batcher.stop()
         self.tenants.stop()
+        self._persist_state()
         if self.audit is not None:
             self.audit.close()
-        log.info("tpu-engine sidecar stopped")
+        drain_s = _time.monotonic() - t0
+        self._m_drain.set(drain_s)
+        log.info("tpu-engine sidecar stopped", drain_s=round(drain_s, 3))

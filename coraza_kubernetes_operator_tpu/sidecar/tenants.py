@@ -88,6 +88,7 @@ class TenantManager:
         engine_factory=WafEngine,
         on_swap=None,
         rollout=None,
+        on_persist=None,
     ):
         self.cache_base_url = cache_base_url
         self.poll_interval_s = poll_interval_s
@@ -104,6 +105,7 @@ class TenantManager:
             else SharedEngineFactory(engine_factory)
         )
         self._on_swap = on_swap  # forwarded to every tenant's reloader
+        self._on_persist = on_persist  # likewise (durable-state snapshot)
         # Staged-rollout manager (sidecar/rollout.py), shared across
         # tenants: one shadow-mirror router and one set of outcome
         # counters; each tenant's reloader stages its own candidates.
@@ -125,6 +127,7 @@ class TenantManager:
                 engine_factory=self._engine_factory,
                 on_swap=self._on_swap,
                 rollout=self._rollout,
+                on_persist=self._on_persist,
             )
 
     def seed(self, key: str, engine: WafEngine) -> None:
@@ -190,9 +193,47 @@ class TenantManager:
                 ),
                 "rollbacks_forced": r.rollbacks_forced,
                 "lkg_ring": r.ring.uuids(),
+                "restored": r.restored,
             }
             for key, r in reloaders.items()
         }
+
+    # -- durable serving state (docs/RECOVERY.md) ----------------------------
+
+    def snapshot(self) -> dict:
+        """Per-tenant serving-state snapshot for the state store. Tenants
+        with nothing persistable (no engine / no ruleset text) are
+        omitted — a restore simply cold-starts them."""
+        with self._lock:
+            reloaders = dict(self._reloaders)
+        out: dict[str, dict] = {}
+        for key, r in reloaders.items():
+            snap = r.snapshot()
+            if snap is not None:
+                out[key] = snap
+        return {"tenants": out}
+
+    def restore(self, state: dict) -> int:
+        """Restore every known tenant present in the snapshot; returns
+        how many restored. Unknown tenant keys in the snapshot are
+        ignored (the deployment's tenant list is config, not state)."""
+        tenants = state.get("tenants")
+        if not isinstance(tenants, dict):
+            return 0
+        restored = 0
+        for key, snap in tenants.items():
+            with self._lock:
+                reloader = self._reloaders.get(str(key).strip("/"))
+            if reloader is None or not isinstance(snap, dict):
+                continue
+            if reloader.engine is None and reloader.restore(snap):
+                restored += 1
+        return restored
+
+    @property
+    def total_restored(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._reloaders.values() if r.restored)
 
     def analysis_counts(self) -> dict[str, int]:
         """Finding counts by severity summed across tenants' serving

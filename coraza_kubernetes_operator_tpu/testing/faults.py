@@ -21,6 +21,15 @@ Knobs (all default off):
   stream (default 0).
 - ``CKO_FAULT_CACHE_OUTAGE=1``: every cache-server poll fails with a
   connection error — simulating a cache-server outage mid-reload.
+- ``CKO_FAULT_DEVICE_LOST=1``: every device dispatch raises
+  :class:`DeviceLostFault` — a PERSISTENT device loss (the TPU runtime's
+  ``DEVICE_LOST``/device-disappeared class, not a transient kernel
+  fault). Drives the re-init-exhaustion → ``broken`` escalation path.
+- ``CKO_FAULT_DEVICE_LOST_N=<n>``: the NEXT ``n`` device dispatches
+  raise :class:`DeviceLostFault`, then the storm clears on its own — a
+  device loss the runtime recovers from once the sidecar re-puts its
+  arrays on a fresh backend (docs/RECOVERY.md device-loss state
+  machine). Changing the knob's value re-arms the countdown.
 - ``CKO_FAULT_SHADOW_DIVERGE_RATE=<0..1>``: each shadow-verification
   window of a staged rollout (``sidecar/rollout.py``) is forced to read
   as diverged with this probability — simulating a
@@ -69,6 +78,46 @@ class DeviceFault(RuntimeError):
     """An injected device-path failure (stands in for the accelerator
     runtime's kernel faults / tunnel drops). The sidecar's circuit
     breaker treats it exactly like a real device error."""
+
+
+class DeviceLostFault(RuntimeError):
+    """An injected DEVICE-LOST-class failure: the backend is gone, not
+    merely faulting (XLA's ``DEVICE_LOST`` / device-disappeared errors).
+    The sidecar's device-loss manager (docs/RECOVERY.md) treats it as
+    grounds for a full array re-put on a fresh backend, distinct from
+    the transient circuit breaker."""
+
+    def __init__(self, msg: str = "DEVICE_LOST: injected device loss"):
+        super().__init__(msg)
+
+
+_lost_lock = threading.Lock()
+_lost_remaining = 0
+_lost_armed: str | None = None
+
+
+def injected_device_lost() -> bool:
+    """True when this dispatch should fail with a device loss.
+
+    ``CKO_FAULT_DEVICE_LOST=1`` is persistent (every dispatch).
+    ``CKO_FAULT_DEVICE_LOST_N=<n>`` arms a countdown: the next ``n``
+    dispatches fail, then the storm clears — re-arming happens whenever
+    the knob's VALUE changes (set it to a fresh number per scenario)."""
+    global _lost_remaining, _lost_armed
+    if os.environ.get("CKO_FAULT_DEVICE_LOST", "") not in ("", "0"):
+        return True
+    raw = os.environ.get("CKO_FAULT_DEVICE_LOST_N", "")
+    with _lost_lock:
+        if raw != _lost_armed:
+            _lost_armed = raw
+            try:
+                _lost_remaining = max(0, int(raw or 0))
+            except ValueError:
+                _lost_remaining = 0
+        if _lost_remaining > 0:
+            _lost_remaining -= 1
+            return True
+    return False
 
 
 _rng_lock = threading.Lock()
@@ -121,6 +170,8 @@ def on_device_dispatch(warmed: bool) -> None:
         stall = injected_compile_stall_s()
         if stall > 0:
             time.sleep(stall)
+    if injected_device_lost():
+        raise DeviceLostFault()
     if injected_device_error():
         raise DeviceFault("injected device error (CKO_FAULT_DEVICE_ERROR_RATE)")
 

@@ -24,6 +24,19 @@ the fault-injection harness (``testing/faults.py``) end to end:
    adversarial connection is reaped (408 deadline / streaming 413,
    accounted in the governor counters), the in-flight byte ledger
    returns to zero, and process RSS stays bounded.
+7. **crash-restart under cache outage** (ISSUE 12) — the sidecar dies
+   hard mid-traffic (abandoned without ``stop()``: durability must come
+   from the swap-time snapshots alone, never a shutdown hook) and a
+   replacement boots from the same ``CKO_STATE_DIR`` with the rules
+   cache DOWN (``CKO_FAULT_CACHE_OUTAGE=1``). Gated: restored readyz
+   within ``CKO_RESTART_READY_CEILING_S``, the pre-crash serving uuid,
+   and verdict-equivalent rulesets on both replicas — the rolled-back
+   v3 rule must NOT resurrect;
+8. **device-lost storm** (ISSUE 12) — ``CKO_FAULT_DEVICE_LOST_N``
+   invalidates the restored replica's device arrays mid-traffic:
+   verdicts stay correct throughout (fallback rescue, readyz green),
+   the loss is counted in ``cko_device_lost_total``, and the bounded
+   re-init loop recovers device serving.
 
 Throughout, a background traffic storm asserts every response is a real
 verdict (200/403, correct per request) — never a blank 500 — and at the
@@ -37,8 +50,10 @@ import json
 import os
 import re
 import resource
+import shutil
 import socket
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -114,6 +129,9 @@ def main() -> int:
     cache.put(KEY, BASE + EVIL_MONKEY)
     srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
     srv.start()
+    # Durable serving state (docs/RECOVERY.md): every promote/swap writes
+    # a snapshot here; scenario 7 restarts from it after a hard crash.
+    state_dir = tempfile.mkdtemp(prefix="cko-chaos-state-")
     sc = TpuEngineSidecar(
         SidecarConfig(
             host="127.0.0.1",
@@ -127,9 +145,11 @@ def main() -> int:
             shadow_idle_check_s=0.5,
             breaker_threshold=3,
             breaker_cooldown_s=0.5,
+            state_dir=state_dir,
         )
     )
     sc.start()
+    sc2 = None
 
     stop = threading.Event()
     bad: list = []
@@ -317,20 +337,143 @@ def main() -> int:
                          grown_kb=rss_grown_kb)
         del os.environ["CKO_FAULT_CONN_STORM"]
 
+        # 7. Crash-restart under cache outage: the storm is still hitting
+        # sc when the "crash" happens — sc is simply abandoned (its
+        # shutdown persist never runs; the snapshot on disk is whatever
+        # the last swap wrote). The replacement must restore and reach
+        # ready with the rules cache completely down.
+        os.environ["CKO_FAULT_CACHE_OUTAGE"] = "1"
+        serving_uuid = sc.reloader.current_uuid
+        ceiling_s = float(os.environ.get("CKO_RESTART_READY_CEILING_S", "60"))
+        t_restart = time.monotonic()
+        sc2 = TpuEngineSidecar(
+            SidecarConfig(
+                host="127.0.0.1",
+                port=0,
+                cache_base_url=f"http://127.0.0.1:{srv.port}",
+                instance_key=KEY,
+                poll_interval_s=0.1,
+                breaker_threshold=3,
+                breaker_cooldown_s=0.5,
+                state_dir=state_dir,
+            )
+        )
+        sc2.start()
+        if not _wait(
+            lambda: _http(sc2.port, "/waf/v1/readyz")[0] == 200, ceiling_s
+        ):
+            return _fail(
+                "crash_restart",
+                detail="restored replica never ready",
+                ceiling_s=ceiling_s,
+                recovery=sc2.stats().get("recovery"),
+            )
+        ready_s = time.monotonic() - t_restart
+        if sc2.reloader.current_uuid != serving_uuid:
+            return _fail(
+                "crash_restart",
+                detail="serving uuid not restored",
+                want=serving_uuid,
+                got=sc2.reloader.current_uuid,
+            )
+        if sc2.tenants.total_restored < 1:
+            return _fail("crash_restart", detail="restore path not taken")
+        # Prove the outage is real: the restored replica is READY while
+        # its polls are failing.
+        if not _wait(lambda: sc2.reloader.poll_failures > 0, 30):
+            return _fail("crash_restart", detail="cache outage not observed")
+        # Verdict equivalence across the crash: exactly ruleset v2 on
+        # both replicas — monkey+tiger deny; panda (the rule that only
+        # ever existed in the failed/rolled-back v3) and benign pass.
+        for path, want in (
+            ("/?pet=evilmonkey", 403),
+            ("/?pet=eviltiger", 403),
+            ("/?pet=evilpanda", 200),
+            ("/?q=fine", 200),
+        ):
+            for port, who in ((sc.port, "crashed"), (sc2.port, "restored")):
+                status, body = _http(port, path)
+                if status != want or not body:
+                    return _fail(
+                        "crash_restart", path=path, who=who, status=status, want=want
+                    )
+
+        # The storm ran through the crash-restart; close it out before
+        # the device-lost scenario so the injected-loss countdown is
+        # consumed by sc2's traffic alone.
         stop.set()
         storm_thread.join(timeout=10)
         if storm_thread.is_alive():
             return _fail("teardown", detail="storm thread hung")
         if bad:
             return _fail("verdicts", bad=bad[:5], total_bad=len(bad))
+
+        # 8. Device-lost storm on the restored replica: wait for device
+        # serving first so the injected loss hits a PROMOTED path, then
+        # assert no verdict is lost or wrong while the bounded re-init
+        # recovers it.
+        if not _wait(lambda: sc2.serving_mode() == "promoted", 120):
+            return _fail(
+                "device_lost",
+                detail="restored replica never promoted",
+                mode=sc2.serving_mode(),
+            )
+        dl = sc2.degraded.device_loss
+        os.environ["CKO_FAULT_DEVICE_LOST_N"] = "2"
+        lost_bad = []
+        t_loss = time.monotonic()
+        i = 0
+        while time.monotonic() - t_loss < 60:
+            attack = i % 2 == 0
+            path = f"/?pet=evilmonkey&dl={i}" if attack else f"/?q=fine&dl={i}"
+            try:
+                status, body = _http(sc2.port, path)
+            except Exception as err:
+                lost_bad.append((path, f"{type(err).__name__}: {err}"))
+                status, body = None, b""
+            want = 403 if attack else 200
+            if status != want or not body:
+                lost_bad.append((path, status, body[:80]))
+            # Mid-loss the replica must STAY in rotation: re-init serves
+            # from the host fallback, readyz stays green.
+            if dl.state == "reinit" and _http(sc2.port, "/waf/v1/readyz")[0] != 200:
+                lost_bad.append(("readyz_during_reinit", i))
+            i += 1
+            if i >= 20 and dl.losses_total >= 1 and dl.recoveries >= 1:
+                break
+            time.sleep(0.005)
+        del os.environ["CKO_FAULT_DEVICE_LOST_N"]
+        if lost_bad:
+            return _fail(
+                "device_lost", bad=lost_bad[:5], total=len(lost_bad), dl=dl.stats()
+            )
+        if dl.losses_total < 1:
+            return _fail("device_lost", detail="loss never declared", dl=dl.stats())
+        if dl.recoveries < 1:
+            return _fail("device_lost", detail="device never recovered", dl=dl.stats())
+        if int(sc2._m_device_lost.value()) < 1:
+            return _fail("device_lost", detail="cko_device_lost_total not incremented")
+        if not _wait(lambda: sc2.serving_mode() == "promoted", 120):
+            return _fail("device_lost_recovery", mode=sc2.serving_mode())
+        status, _ = _http(sc2.port, "/?pet=evilmonkey&post=recovery")
+        if status != 403:
+            return _fail(
+                "device_lost_recovery", detail=f"post-recovery verdict {status}"
+            )
+
         if sc.serving_mode() not in ("promoted", "fallback"):
             return _fail("final_mode", mode=sc.serving_mode())
         if not _wait(lambda: sc.batcher.inflight_windows() == 0, 30):
             return _fail("teardown", detail="in-flight windows never drained")
+        if not _wait(lambda: sc2.batcher.inflight_windows() == 0, 30):
+            return _fail("teardown", detail="restored in-flight windows not drained")
     finally:
         stop.set()
         sc.stop()
+        if sc2 is not None:
+            sc2.stop()
         srv.stop()
+        shutil.rmtree(state_dir, ignore_errors=True)
         for var in list(os.environ):
             if var.startswith("CKO_FAULT_"):
                 del os.environ[var]
@@ -369,6 +512,8 @@ def main() -> int:
                 "rollouts": rollout.stats() if rollout else None,
                 "storm_requests_bad": len(bad),
                 "ingress": sc.governor.stats(),
+                "restart_ready_s": round(ready_s, 3),
+                "device_loss": dl.stats(),
             }
         )
     )

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Restart smoke (ISSUE 12 CI satellite): crash-safe warm restart across
+a REAL process boundary.
+
+The chaos smoke proves the restore machinery in-process; this gate
+proves it the way production experiences it — ``kill -9``, a fresh
+interpreter, and a dead rules cache:
+
+1. boot the ``tpu-engine`` sidecar as a subprocess against a live cache
+   server, wait for ready, and record the exact verdict BYTES for a
+   fixed corpus (``POST /waf/v1/evaluate``);
+2. SIGKILL it mid-traffic — no shutdown hook runs, so durability must
+   come from the swap-time snapshots in ``--state-dir`` alone;
+3. restart it on the same state dir with the cache unreachable
+   (``CKO_FAULT_CACHE_OUTAGE=1``). Gates: readyz 200 within
+   ``CKO_RESTART_READY_CEILING_S`` wall seconds (default 90, covering a
+   cold interpreter + restore), the restore counters in
+   ``/waf/v1/stats`` recovery block, the pre-crash serving uuid, and
+   verdict bytes BIT-IDENTICAL to the pre-crash baseline;
+4. SIGTERM: readyz flips 503 (drain begun) and the process exits 0
+   within the termination grace window.
+
+Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+BASE = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+"""
+EVIL_MONKEY = (
+    'SecRule ARGS|REQUEST_URI "@contains evilmonkey" '
+    '"id:3001,phase:2,deny,status:403"\n'
+)
+KEY = "default/ruleset"
+
+CORPUS = json.dumps(
+    {
+        "requests": [
+            {"method": "GET", "uri": "/?q=evilmonkey"},
+            {"method": "GET", "uri": "/?q=benign-value"},
+            {
+                "method": "POST",
+                "uri": "/form",
+                "headers": {"Content-Type": "application/x-www-form-urlencoded"},
+                "body": "pet=evilmonkey&x=1",
+            },
+            {"method": "GET", "uri": "/static/asset.css"},
+        ]
+    }
+).encode()
+
+
+def _fail(stage: str, **detail) -> int:
+    print(json.dumps({"restart_smoke": "FAIL", "stage": stage, **detail}))
+    return 1
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http(port, path, method="GET", body=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method, data=body
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _ready(port) -> bool:
+    try:
+        return _http(port, "/waf/v1/readyz", timeout=5)[0] == 200
+    except Exception:
+        return False
+
+
+def _wait(predicate, timeout_s=60.0, interval_s=0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def _stats(port) -> dict:
+    return json.loads(_http(port, "/waf/v1/stats")[1])
+
+
+def _spawn(port: int, srv_port: int, state_dir: str, extra_env=None):
+    env = os.environ.copy()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    cmd = [
+        sys.executable,
+        "-m",
+        "coraza_kubernetes_operator_tpu.cmd.tpu_engine",
+        f"--cache-server-instance={KEY}",
+        f"--cache-server-cluster=127.0.0.1:{srv_port}",
+        "--rule-reload-interval-seconds=0.2",
+        "--bind-address=127.0.0.1",
+        f"--port={port}",
+        f"--state-dir={state_dir}",
+        "--drain-budget-seconds=10",
+    ]
+    # stdout/stderr inherit: the sidecar's logs interleave into the CI
+    # job output, which is exactly what a postmortem needs.
+    return subprocess.Popen(cmd, cwd=str(REPO), env=env)
+
+
+def main() -> int:
+    for var in list(os.environ):
+        if var.startswith("CKO_FAULT_"):
+            del os.environ[var]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(REPO))
+    from coraza_kubernetes_operator_tpu.cache import RuleSetCache, RuleSetCacheServer
+
+    cache = RuleSetCache()
+    cache.put(KEY, BASE + EVIL_MONKEY)
+    srv = RuleSetCacheServer(cache, host="127.0.0.1", port=0)
+    srv.start()
+    state_dir = tempfile.mkdtemp(prefix="cko-restart-state-")
+    proc = proc2 = None
+    stop = threading.Event()
+    kill_t = [float("inf")]
+    storm_bad: list = []
+    try:
+        # 1. First life: boot, serve, baseline.
+        port = _free_port()
+        proc = _spawn(port, srv.port, state_dir)
+        if not _wait(lambda: _ready(port), 180):
+            return _fail("boot", detail="sidecar never ready")
+        status, baseline = _http(port, "/waf/v1/evaluate", method="POST", body=CORPUS)
+        if status != 200:
+            return _fail("baseline", status=status, body=baseline[:120].decode("latin1"))
+        verdicts = json.loads(baseline)["verdicts"]
+        if [v["interrupted"] for v in verdicts] != [True, False, True, False]:
+            return _fail("baseline", detail="unexpected corpus verdicts", verdicts=verdicts)
+        uuid_before = _stats(port)["tenants"][KEY]["uuid"]
+        snapshot = Path(state_dir) / "serving_state.json"
+        if not _wait(snapshot.exists, 10):
+            return _fail("baseline", detail="no snapshot written at swap time")
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                # Failures observed AFTER the kill landed don't count:
+                # the kill rips in-flight connections by design.
+                try:
+                    s, body = _http(port, f"/?pet=evilmonkey&i={i}", timeout=10)
+                    if (s != 403 or not body) and time.monotonic() < kill_t[0]:
+                        storm_bad.append((i, s))
+                except Exception as err:
+                    if time.monotonic() < kill_t[0]:
+                        storm_bad.append((i, f"{type(err).__name__}: {err}"))
+                i += 1
+                time.sleep(0.005)
+
+        storm_thread = threading.Thread(target=storm, daemon=True)
+        storm_thread.start()
+        time.sleep(0.5)  # traffic genuinely in flight
+
+        # 2. kill -9: no drain, no persist-on-exit — the crash case.
+        kill_t[0] = time.monotonic()
+        proc.kill()
+        proc.wait(timeout=30)
+        stop.set()
+        storm_thread.join(timeout=10)
+        if storm_bad:
+            return _fail("pre_kill_traffic", bad=storm_bad[:5], total=len(storm_bad))
+
+        # 3. Second life: same state dir, cache DOWN.
+        port2 = _free_port()
+        ceiling_s = float(os.environ.get("CKO_RESTART_READY_CEILING_S", "90"))
+        t0 = time.monotonic()
+        proc2 = _spawn(
+            port2, srv.port, state_dir, extra_env={"CKO_FAULT_CACHE_OUTAGE": "1"}
+        )
+        if not _wait(lambda: _ready(port2), ceiling_s):
+            return _fail(
+                "restart", detail="restored sidecar never ready", ceiling_s=ceiling_s
+            )
+        ready_s = time.monotonic() - t0
+        stats = _stats(port2)
+        rec = stats.get("recovery") or {}
+        if rec.get("restored_tenants", 0) < 1 or rec.get("restore_success", 0) < 1:
+            return _fail("restart", detail="restore counters not set", recovery=rec)
+        if stats["tenants"][KEY]["uuid"] != uuid_before:
+            return _fail(
+                "restart",
+                detail="serving uuid not restored",
+                want=uuid_before,
+                got=stats["tenants"][KEY]["uuid"],
+            )
+        # The outage is real: ready while polls fail.
+        if not _wait(
+            lambda: _stats(port2)["tenants"][KEY]["poll_failures"] > 0, 30
+        ):
+            return _fail("restart", detail="cache outage not observed")
+        status, restored = _http(
+            port2, "/waf/v1/evaluate", method="POST", body=CORPUS
+        )
+        if status != 200:
+            return _fail("restored_verdicts", status=status)
+        if restored != baseline:
+            return _fail(
+                "restored_verdicts",
+                detail="verdict bytes differ across restart",
+                baseline=baseline.decode("latin1")[:200],
+                restored=restored.decode("latin1")[:200],
+            )
+
+        # 4. Graceful exit: SIGTERM -> readyz 503 -> exit 0 within grace.
+        proc2.send_signal(signal.SIGTERM)
+        if not _wait(lambda: not _ready(port2), 10):
+            return _fail("drain", detail="readyz stayed ready after SIGTERM")
+        try:
+            rc = proc2.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            return _fail("drain", detail="sidecar did not exit within grace window")
+        if rc != 0:
+            return _fail("drain", detail="non-zero exit after graceful drain", rc=rc)
+    finally:
+        stop.set()
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        srv.stop()
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    print(
+        json.dumps(
+            {
+                "restart_smoke": "PASS",
+                "restart_ready_s": round(ready_s, 3),
+                "ceiling_s": ceiling_s,
+                "uuid": uuid_before,
+                "recovery": rec,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,11 +205,29 @@ def test_engine_tpu_driver_provisioning(env):
     r = EngineReconciler(store, recorder, cache_server_cluster="cache.svc")
     r.reconcile(NS, "eng")
     dep = store.get("Deployment", NS, "coraza-tpu-engine-eng")
-    args = dep.spec["template"]["spec"]["containers"][0]["args"]
+    pod_spec = dep.spec["template"]["spec"]
+    container = pod_spec["containers"][0]
+    args = container["args"]
     assert f"--cache-server-instance={NS}/rs" in args
     assert "--rule-reload-interval-seconds=7" in args
     assert "--failure-policy=fail" in args  # forwarded, unlike the reference
     assert recorder.has_event("Normal", "TpuEngineProvisioned")
+    # Graceful-termination sizing (docs/RECOVERY.md): the grace period
+    # must cover preStop + drain budget + persist margin, pinned so a
+    # kubelet-default change can never silently truncate the drain.
+    from coraza_kubernetes_operator_tpu.controlplane.engine_controller import (
+        TPU_ENGINE_DRAIN_BUDGET_SECONDS,
+        TPU_ENGINE_PRESTOP_SLEEP_SECONDS,
+        TPU_ENGINE_TERMINATION_GRACE_SECONDS,
+    )
+
+    assert pod_spec["terminationGracePeriodSeconds"] == 30
+    assert container["lifecycle"]["preStop"]["exec"]["command"] == ["sleep", "5"]
+    assert f"--drain-budget-seconds={TPU_ENGINE_DRAIN_BUDGET_SECONDS}" in args
+    assert (
+        TPU_ENGINE_TERMINATION_GRACE_SECONDS
+        >= TPU_ENGINE_PRESTOP_SLEEP_SECONDS + TPU_ENGINE_DRAIN_BUDGET_SECONDS + 5
+    )
 
 
 def test_engine_deleted_cascades_to_owned(env):

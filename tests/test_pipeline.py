@@ -505,6 +505,10 @@ def test_pipeline_stats_and_metrics_exposed(monkeypatch):
         status, _, _ = _http(sc.port, "/?pet=evilmonkey")
         assert status == 403
         assert _wait(lambda: sc.batcher.stats.host_stage_s, timeout_s=10)
+        # Stage samples record just before the collector retires the
+        # window (decrements the in-flight count) — wait for quiescence
+        # rather than racing the collector's finally block.
+        assert _wait(lambda: sc.batcher.inflight_windows() == 0, timeout_s=10)
         _, _, body = _http(sc.port, "/waf/v1/stats")
         stats = json.loads(body)
         assert stats["pipeline"]["depth"] == 2
