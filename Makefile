@@ -64,7 +64,7 @@ ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontend
 	$(PYTHON) hack/ingest_fuzz.py
 
 .PHONY: chaos.smoke
-chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm, crash-restart, device loss.
+chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm, crash-restart, device loss, poison storm.
 	$(PYTHON) hack/chaos_smoke.py
 
 .PHONY: restart.smoke

@@ -66,7 +66,21 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         " assembles (double-buffered dispatch, docs/PIPELINE.md); default"
         " $CKO_PIPELINE_DEPTH or 2, 1 reverts to synchronous dispatch",
     )
-    p.add_argument("--request-timeout-seconds", type=float, default=30.0)
+    p.add_argument(
+        "--request-timeout-seconds",
+        type=float,
+        default=None,
+        help="per-request verdict wait budget; default $CKO_REQUEST_TIMEOUT_S"
+        " or 30",
+    )
+    p.add_argument(
+        "--window-deadline-seconds",
+        type=float,
+        default=None,
+        help="dispatch-watchdog per-window device deadline"
+        " (docs/DEGRADED_MODE.md); default $CKO_WINDOW_DEADLINE_S or auto"
+        " (~10x warm p99 once warmed); <= 0 disables",
+    )
     p.add_argument(
         "--compile-timeout-seconds",
         type=float,
@@ -258,6 +272,7 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         port=args.port,
         frontend=args.frontend,
         request_timeout_s=args.request_timeout_seconds,
+        window_deadline_s=args.window_deadline_seconds,
         compile_timeout_s=args.compile_timeout_seconds,
         audit_log=args.audit_log or None,
         audit_relevant_only=not args.audit_all,

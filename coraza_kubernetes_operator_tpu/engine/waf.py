@@ -701,6 +701,13 @@ class WafEngine:
                         else Verdict(interrupted=True, status=413, rule_id=None)
                     )
         live = [r for i, r in enumerate(requests) if i not in rejected]
+        from ..testing.faults import DeviceFault, poison_marker
+
+        marker = poison_marker()
+        if marker is not None and any(marker in r.body for r in live):
+            raise DeviceFault(
+                "injected poison request (CKO_FAULT_POISON_MARKER)"
+            )
         if not live:
             return InFlightBatch(
                 out=None,
@@ -738,6 +745,13 @@ class WafEngine:
             from ..native import blob_requests
 
             return self.prepare(blob_requests(blob, n_req))
+        from ..testing.faults import DeviceFault, poison_marker
+
+        marker = poison_marker()
+        if marker is not None and marker in blob:
+            raise DeviceFault(
+                "injected poison request (CKO_FAULT_POISON_MARKER)"
+            )
         t0 = time.perf_counter()
         prog = self.compiled.program
         overrides: dict[int, Verdict] = {}
@@ -785,6 +799,11 @@ class WafEngine:
             return [
                 inflight.rejected[i] for i in range(inflight.n_requests)
             ]
+        from ..testing.faults import injected_device_hang_s
+
+        hang = injected_device_hang_s()
+        if hang > 0:
+            time.sleep(hang)
         t0 = time.perf_counter()
         if inflight.cache_pop:
             packed, tier_hits = jax.device_get(inflight.out)

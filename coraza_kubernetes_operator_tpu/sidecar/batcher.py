@@ -126,6 +126,14 @@ class _Group:
     inflight: object = None  # InFlightBatch (pipelined path)
     verdicts: list[Verdict] | None = None  # sync path (phase_split / stubs)
     error: BaseException | None = None
+    # Quarantined group (sidecar/quarantine.py): its requests matched the
+    # poison registry at assembly time and are answered by host fallback
+    # in the collect stage — never dispatched to device, never feeding
+    # the breaker or device stats.
+    quarantined: bool = False
+    # Materialized requests, kept only where a later stage needs them
+    # (quarantined groups; blob split groups for fault classification).
+    reqs: list | None = None
 
 
 @dataclass
@@ -147,6 +155,26 @@ class _BlobWindow:
 class _WindowRecord:
     window: object  # list of (req, tenant, fut) triples, or a _BlobWindow
     groups: list
+    # Blob window split by quarantine routing: groups carry idxs into the
+    # blob's request index space and the collect stage stitches verdicts
+    # back into one list for the window future.
+    split: bool = False
+
+
+@dataclass
+class _ReadbackJob:
+    """One deadline-supervised device readback, handed to the disposable
+    readback worker. ``lock`` serializes the completion/abandon race:
+    the worker publishes results and sets ``done`` under it; the
+    collector re-checks ``done`` under it before abandoning."""
+
+    engine: object
+    inflight: object
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    done: threading.Event = field(default_factory=threading.Event)
+    abandoned: bool = False
+    verdicts: list | None = None
+    error: BaseException | None = None
 
 
 class MicroBatcher:
@@ -237,6 +265,40 @@ class MicroBatcher:
         self.drained_requests = 0
         self.drain_failed = 0
         self._drain_deadline_t: float | None = None
+        # Per-request wait budget for evaluate(); the sidecar resolves it
+        # config field -> CKO_REQUEST_TIMEOUT_S -> 30.0.
+        self.request_timeout_s = 30.0
+        # Dispatch watchdog (per-window device deadline). None = auto
+        # (~10x warm p99 once the engine is warmed AND enough latency
+        # samples exist); <= 0 disables; an explicit positive value is
+        # still gated on engine.warmed (a cold XLA compile legitimately
+        # takes minutes). A blown deadline ABANDONS the window: its
+        # futures fail with WindowAbandoned (the server's rescue paths
+        # re-answer them from host fallback — real verdicts, zero lost),
+        # the stuck readback parks on a disposable worker, and the
+        # collector FIFO keeps moving.
+        self.window_deadline_s: float | None = None
+        self.windows_abandoned = 0
+        self.parked_readbacks = 0
+        # Auto-deadline gate: below this many latency samples the p99 is
+        # too noisy to trust as a deadline baseline.
+        self._deadline_min_samples = 20
+        self._readback_q: queue.Queue[_ReadbackJob | None] | None = None
+        self._readback_thread: threading.Thread | None = None
+        # Poison quarantine (sidecar/quarantine.py): a registry with
+        # match(req) consulted at batch-assembly time; matching requests
+        # are answered by fallback_evaluate(engine, requests) instead of
+        # riding a device window. on_window_fault(engine, err,
+        # requests_fn) supersedes on_engine_error for device-window
+        # faults when set — the sidecar routes loss-class errors to the
+        # device-loss manager and the rest to the bisector/breaker.
+        self.quarantine = None
+        self.fallback_evaluate = None  # (engine, requests) -> list[Verdict]
+        self.on_window_fault = None  # (engine, err, requests_fn|None) -> None
+        # Collector-leak visibility: stop() flips this when the collect
+        # thread outlives its join budget instead of leaking silently.
+        self.collector_wedged = False
+        self._collector_join_s = 30.0
         # Requests inside queued-but-not-dispatched blob windows; the
         # admission-control signal must count them (a blob window is one
         # queue item but n_req requests of backlog).
@@ -303,7 +365,23 @@ class MicroBatcher:
         else:
             self._inflight.put(None)
         if self._collector:
-            self._collector.join(timeout=30)
+            self._collector.join(timeout=self._collector_join_s)
+            if self._collector.is_alive():
+                # A wedged collector means some window's readback never
+                # returned and its depth slot is gone for good. Flag it
+                # loudly — a silent leak here previously survived stop()
+                # unnoticed.
+                self.collector_wedged = True
+                log.critical(
+                    "collector thread still alive past the stop budget — "
+                    "a device readback is wedged; its futures will not "
+                    "resolve",
+                    join_budget_s=self._collector_join_s,
+                    inflight=self.inflight_windows(),
+                )
+        q = self._readback_q
+        if q is not None:
+            q.put(None)
         self._drain_pending()
 
     def _drain_pending(self) -> None:
@@ -432,8 +510,13 @@ class MicroBatcher:
             return self._blob_pending_bytes
 
     def evaluate(
-        self, request: HttpRequest, timeout_s: float = 30.0, tenant: str | None = None
+        self,
+        request: HttpRequest,
+        timeout_s: float | None = None,
+        tenant: str | None = None,
     ) -> Verdict:
+        if timeout_s is None:
+            timeout_s = self.request_timeout_s
         return self.submit(request, tenant=tenant).result(timeout=timeout_s)
 
     # -- dispatch stage ------------------------------------------------------
@@ -526,6 +609,12 @@ class MicroBatcher:
         groups: dict[int, list[int]] = {}
         group_engine: dict[int, WafEngine] = {}
         missing: dict[str | None, list[int]] = {}
+        quarantined: dict[int, list[int]] = {}
+        # Quarantine gate: len() is cheap and the registry is empty in
+        # the steady state, so the hot path pays one attribute read.
+        registry = self.quarantine
+        if registry is not None and not len(registry):
+            registry = None
         # engine_fn resolved once per DISTINCT tenant (it may take the
         # tenant-manager lock); memoizing also pins one engine per tenant
         # for the whole window even if a hot reload lands mid-grouping.
@@ -543,8 +632,23 @@ class MicroBatcher:
                 continue
             key = id(engine)
             group_engine[key] = engine
+            if registry is not None and registry.match(_req):
+                # Quarantined poison: answered by host fallback in the
+                # collect stage — it never rides a device window again.
+                quarantined.setdefault(key, []).append(idx)
+                continue
             groups.setdefault(key, []).append(idx)
         out_groups: list[_Group] = []
+        for key, idxs in quarantined.items():
+            out_groups.append(
+                _Group(
+                    engine=group_engine[key],
+                    idxs=idxs,
+                    t_dispatch=time.monotonic(),
+                    quarantined=True,
+                    reqs=[window[i][0] for i in idxs],
+                )
+            )
         for tenant, idxs in missing.items():
             out_groups.append(
                 _Group(
@@ -582,6 +686,17 @@ class MicroBatcher:
         ``prepare_blob`` call. Engines without the blob API (test stubs)
         materialize the requests and evaluate synchronously."""
         engine = self._engine_fn(None)
+        registry = self.quarantine
+        if engine is not None and registry is not None and len(registry):
+            try:
+                record = self._dispatch_blob_split(bw, engine, registry)
+            except Exception as err:
+                # Materialization/probe failure: fall through to the
+                # normal blob dispatch — quarantine is best-effort.
+                log.error("quarantine blob probe failed", err)
+                record = None
+            if record is not None:
+                return record
         g = _Group(engine=engine, idxs=[], t_dispatch=time.monotonic())
         if engine is None:
             g.error = EngineUnavailable(
@@ -602,6 +717,53 @@ class MicroBatcher:
             except Exception as err:
                 g.error = err
         return _WindowRecord(window=bw, groups=[g])
+
+    def _dispatch_blob_split(
+        self, bw: _BlobWindow, engine, registry
+    ) -> _WindowRecord | None:
+        """Quarantine routing for a blob window: materialize the
+        requests, split quarantined rows from clean ones, dispatch the
+        clean remainder per-request (``engine.prepare``) and mark the
+        rest for fallback in the collect stage. Returns None when
+        nothing matched — the caller then runs the normal zero-copy blob
+        dispatch (the materialization cost only taxes windows while the
+        registry is non-empty)."""
+        from ..native import blob_requests
+
+        reqs = blob_requests(bw.blob, bw.n_req)
+        qidx = [i for i, r in enumerate(reqs) if registry.match(r)]
+        if not qidx:
+            return None
+        qset = set(qidx)
+        groups: list[_Group] = []
+        clean_idx = [i for i in range(bw.n_req) if i not in qset]
+        if clean_idx:
+            g = _Group(
+                engine=engine,
+                idxs=clean_idx,
+                t_dispatch=time.monotonic(),
+                reqs=[reqs[i] for i in clean_idx],
+            )
+            try:
+                if self.phase_split:
+                    g.verdicts = engine.evaluate_phased(g.reqs)
+                elif hasattr(engine, "prepare"):
+                    g.inflight = engine.prepare(g.reqs)
+                else:
+                    g.verdicts = engine.evaluate(g.reqs)
+            except Exception as err:
+                g.error = err
+            groups.append(g)
+        groups.append(
+            _Group(
+                engine=engine,
+                idxs=qidx,
+                t_dispatch=time.monotonic(),
+                quarantined=True,
+                reqs=[reqs[i] for i in qidx],
+            )
+        )
+        return _WindowRecord(window=bw, groups=groups, split=True)
 
     # -- collect stage -------------------------------------------------------
 
@@ -633,14 +795,158 @@ class MicroBatcher:
                     self._inflight_count -= 1
                 self._depth_sem.release()
 
+    # -- dispatch watchdog ---------------------------------------------------
+
+    def _window_deadline_for(self, engine) -> float | None:
+        """Effective per-window device deadline, or None (watchdog off).
+
+        Explicit ``window_deadline_s`` wins (<= 0 disables); otherwise
+        auto: 10x the warm p99 step latency, floored at 1s. Either way
+        the deadline only arms on a WARMED engine with enough latency
+        samples — a cold XLA compile legitimately blocks for minutes and
+        must never be abandoned."""
+        d = self.window_deadline_s
+        if d is not None and d <= 0:
+            return None
+        if not getattr(engine, "warmed", False):
+            return None
+        if d is not None:
+            return d
+        lats = self.stats.step_latencies_s
+        if len(lats) < self._deadline_min_samples:
+            return None
+        return max(1.0, 10.0 * _nearest_rank(sorted(lats), 0.99))
+
+    def _spawn_readback_worker(self) -> None:
+        if self._readback_q is None:
+            self._readback_q = queue.Queue()
+        self._readback_thread = threading.Thread(
+            target=self._readback_loop,
+            name="batcher-readback",
+            daemon=True,
+        )
+        self._readback_thread.start()
+
+    def _readback_loop(self) -> None:
+        q = self._readback_q
+        while True:
+            job = q.get()
+            if job is None:
+                return
+            try:
+                verdicts = job.engine.collect(job.inflight)
+                error = None
+            except BaseException as err:
+                verdicts, error = None, err
+            with job.lock:
+                job.verdicts = verdicts
+                job.error = error
+                abandoned = job.abandoned
+                job.done.set()
+            if abandoned:
+                # Late completion of an abandoned window: its futures
+                # were already failed over to fallback. Account the
+                # un-parking, surface loss-class errors to the fault
+                # classifier (a DEVICE_LOST landing late must still
+                # reach the device-loss manager), and EXIT — a
+                # replacement worker owns the queue since the abandon.
+                with self._inflight_lock:
+                    self.parked_readbacks -= 1
+                log.error(
+                    "abandoned window readback completed late",
+                    error,
+                    parked=self.parked_readbacks,
+                )
+                if error is not None:
+                    self._notify(self.on_window_fault, job.engine, error, None)
+                return
+
+    def _collect_group(self, g: _Group) -> list[Verdict]:
+        """Collect one device group's readback, supervised by the window
+        deadline when armed. Raises ``WindowAbandoned`` on a blown
+        deadline; the group's futures then fail with it and the server's
+        rescue paths re-answer them from host fallback."""
+        deadline = self._window_deadline_for(g.engine)
+        if deadline is None:
+            return g.engine.collect(g.inflight)
+        # Age from dispatch time, but give every window a grace floor:
+        # a window queued behind an abandoned one must not be charged
+        # the full wait and spuriously abandoned in a cascade.
+        elapsed = time.monotonic() - g.t_dispatch
+        budget = max(deadline - elapsed, min(deadline, 1.0))
+        if self._readback_thread is None or not self._readback_thread.is_alive():
+            self._spawn_readback_worker()
+        job = _ReadbackJob(engine=g.engine, inflight=g.inflight)
+        self._readback_q.put(job)
+        if not job.done.wait(timeout=budget):
+            with job.lock:
+                if not job.done.is_set():
+                    # Lost the race for good: park the readback and move
+                    # the FIFO along. The worker thread stays blocked in
+                    # collect(); a fresh worker takes over the queue.
+                    job.abandoned = True
+            if job.abandoned:
+                with self._inflight_lock:
+                    self.windows_abandoned += 1
+                    self.parked_readbacks += 1
+                self._spawn_readback_worker()
+                raise WindowAbandoned(
+                    f"device readback exceeded the window deadline "
+                    f"({deadline:.3f}s); window abandoned to host fallback"
+                )
+        if job.error is not None:
+            raise job.error
+        return job.verdicts
+
+    def _window_fault(self, g: _Group, requests_fn) -> None:
+        """Classify a device-window fault. ``on_window_fault`` (the
+        sidecar's taxonomy: loss-class -> DeviceLossManager, else
+        quarantine bisector, else breaker) supersedes the legacy
+        ``on_engine_error`` breaker feed when wired; raw-batcher users
+        keep the old behavior exactly."""
+        if self.on_window_fault is not None:
+            try:
+                self.on_window_fault(g.engine, g.error, requests_fn)
+                return
+            except Exception as err:
+                log.error("window fault hook failed", err)
+        self._notify(self.on_engine_error, g.engine, g.error)
+
+    def _quarantine_eval(self, g: _Group) -> list[Verdict]:
+        """Answer a quarantined group off the device path."""
+        reqs = g.reqs or []
+        if self.fallback_evaluate is not None:
+            return self.fallback_evaluate(g.engine, reqs)
+        fallback = getattr(g.engine, "host_fallback", None)
+        if fallback is not None:
+            return fallback.evaluate(reqs)
+        return g.engine.evaluate(reqs)
+
+    def _collect_quarantined(self, record: _WindowRecord, g: _Group) -> None:
+        """Resolve a quarantined group's futures from host fallback —
+        no breaker traffic, no device stats, no shadow mirror."""
+        try:
+            verdicts = self._quarantine_eval(g)
+        except Exception as err:
+            self.stats.errors += len(g.idxs)
+            log.error("quarantined group evaluation failed", err, batch=len(g.idxs))
+            for i in g.idxs:
+                _resolve(record.window[i][2].set_exception, err)
+            return
+        for i, verdict in zip(g.idxs, verdicts):
+            _resolve(record.window[i][2].set_result, verdict)
+
     def _collect_record(self, record: _WindowRecord) -> None:
         if isinstance(record.window, _BlobWindow):
             self._collect_blob(record)
             return
         for g in record.groups:
+            if g.quarantined:
+                self._collect_quarantined(record, g)
+                continue
             if g.error is None and g.verdicts is None:
                 try:
-                    g.verdicts = g.engine.collect(g.inflight)
+                    g.verdicts = self._collect_group(g)
                 except Exception as err:
                     g.error = err
             if g.error is not None:
@@ -653,7 +959,9 @@ class MicroBatcher:
                     continue
                 log.error("batch evaluation failed", g.error, batch=len(g.idxs))
                 self.stats.errors += len(g.idxs)
-                self._notify(self.on_engine_error, g.engine, g.error)
+                self._window_fault(
+                    g, lambda g=g: [record.window[i][0] for i in g.idxs]
+                )
                 for i in g.idxs:
                     _resolve(record.window[i][2].set_exception, g.error)
                 continue
@@ -702,17 +1010,20 @@ class MicroBatcher:
         is actually shadowing this engine) materialize the requests for
         the shadow mirror."""
         bw: _BlobWindow = record.window
+        if record.split:
+            self._collect_blob_split(record)
+            return
         g = record.groups[0]
         if g.error is None and g.verdicts is None:
             try:
-                g.verdicts = g.engine.collect(g.inflight)
+                g.verdicts = self._collect_group(g)
             except Exception as err:
                 g.error = err
         if g.error is not None:
             self.stats.errors += bw.n_req
             if g.engine is not None:
                 log.error("blob window evaluation failed", g.error, batch=bw.n_req)
-                self._notify(self.on_engine_error, g.engine, g.error)
+                self._window_fault(g, lambda: _blob_requests_fn(bw))
             _resolve(bw.fut.set_exception, g.error)
             return
         self._notify(self.on_engine_success, g.engine)
@@ -752,6 +1063,48 @@ class MicroBatcher:
                     self.on_window, g.engine, reqs, list(g.verdicts), serving_s
                 )
 
+    def _collect_blob_split(self, record: _WindowRecord) -> None:
+        """Collect a quarantine-split blob window: the clean device
+        group and the quarantined fallback group each produce verdicts
+        for their idxs, stitched back into one list for the window
+        future. Any group failure fails the whole window future (the
+        server's rescue re-answers it from fallback — no verdict lost).
+        The shadow mirror is skipped in split mode (sampling loss while
+        a quarantine is active is acceptable)."""
+        bw: _BlobWindow = record.window
+        out: list[Verdict | None] = [None] * bw.n_req
+        for g in record.groups:
+            try:
+                if g.quarantined:
+                    verdicts = self._quarantine_eval(g)
+                else:
+                    if g.error is not None:
+                        raise g.error
+                    if g.verdicts is None:
+                        g.verdicts = self._collect_group(g)
+                    verdicts = g.verdicts
+            except Exception as err:
+                self.stats.errors += bw.n_req
+                log.error(
+                    "split blob window evaluation failed", err, batch=bw.n_req
+                )
+                if not g.quarantined and g.engine is not None:
+                    g.error = err
+                    self._window_fault(g, lambda g=g: g.reqs)
+                _resolve(bw.fut.set_exception, err)
+                return
+            if not g.quarantined:
+                self._notify(self.on_engine_success, g.engine)
+                try:
+                    self.stats.record(
+                        len(g.idxs), time.monotonic() - g.t_dispatch
+                    )
+                except Exception as err:
+                    log.error("batch stats hook failed", err)
+            for i, verdict in zip(g.idxs, verdicts):
+                out[i] = verdict
+        _resolve(bw.fut.set_result, out)
+
     def _wants_window(self, engine) -> bool:
         try:
             return bool(self.window_wanted(engine))
@@ -770,6 +1123,15 @@ class MicroBatcher:
             log.error("batcher hook failed", err)
 
 
+def _blob_requests_fn(bw: _BlobWindow):
+    """Materialize a blob window's requests for the fault classifier
+    (only called when a window actually faulted — never on the hot
+    path)."""
+    from ..native import blob_requests
+
+    return blob_requests(bw.blob, bw.n_req)
+
+
 def _resolve(setter, value) -> None:
     """Set a future's result/exception, tolerating callers that CANCELLED
     the future (deadline-missed requests re-answered by the fallback
@@ -784,3 +1146,11 @@ def _resolve(setter, value) -> None:
 class EngineUnavailable(RuntimeError):
     """Raised when a window runs with no loaded ruleset; the server maps this
     through the Engine failurePolicy (fail-closed 503 / fail-open pass)."""
+
+
+class WindowAbandoned(RuntimeError):
+    """The dispatch watchdog gave up on a window's device readback (the
+    per-window deadline blew). The window's futures fail with this; the
+    server's rescue paths re-answer them from the host fallback, so the
+    caller still gets a real verdict. The stuck readback keeps running
+    on a parked worker thread (``cko_parked_readbacks``)."""

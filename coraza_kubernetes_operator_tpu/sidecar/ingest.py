@@ -98,6 +98,7 @@ _CONTROL_TARGETS = {
     b"/waf/v1/stats",
     b"/waf/v1/metrics",
     b"/waf/v1/rollback",
+    b"/waf/v1/quarantine/flush",
 }
 _pack = struct.pack
 
@@ -857,6 +858,10 @@ class AsyncIngestFrontend:
                 )
             if path == API_PREFIX + "rollback":
                 return self._spawn(self._ctl_pool, sc.rollback_reply, body)
+            if path == API_PREFIX + "quarantine/flush":
+                return self._spawn(
+                    self._ctl_pool, sc.quarantine_flush_reply, body
+                )
         return self._done(
             (
                 404,
@@ -1003,12 +1008,15 @@ class AsyncIngestFrontend:
         err = wfut.exception()
         if err is None:
             verdicts = wfut.result()
+            # Verdict counters BEFORE the replies resolve: a client that
+            # reads its answer then scrapes metrics must see it counted.
+            # The audit half (blob materialization + file IO) stays off
+            # the loop thread.
+            sc.count_window(verdicts)
             for f, v in zip(futs, verdicts):
                 if not f.done():
                     f.set_result(sc.verdict_filter_reply(v))
-            # Batch accounting (verdict counters + audit from the blob)
-            # off the loop thread.
-            self._submit_eval(sc.record_window, engine, blob, verdicts)
+            self._submit_eval(sc.record_window, engine, blob, verdicts, True)
             return
         if isinstance(err, EngineUnavailable):
             self._answer_all(futs, sc.unavailable_reply)

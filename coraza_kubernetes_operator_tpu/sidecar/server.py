@@ -60,7 +60,9 @@ from .degraded import (
     DeviceLossManager,
     Overloaded,
 )
+from .degraded import is_device_loss
 from .governor import BadContentLength, BodyTooLarge, IngressGovernor, MemoryShed
+from .quarantine import PoisonBisector, QuarantineRegistry
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .rollout import RolloutConfig, RolloutManager
 from .state_store import StateStore
@@ -117,7 +119,15 @@ class SidecarConfig:
     # "threaded" is the legacy ThreadingHTTPServer (one thread per
     # connection) kept as an escape hatch and as the parity reference.
     frontend: str = "async"
-    request_timeout_s: float = 30.0
+    # Per-request verdict wait budget. None reads CKO_REQUEST_TIMEOUT_S
+    # (default 30). Resolved once at startup; the resolved float is
+    # written back onto this field so every reader sees one value.
+    request_timeout_s: float | None = None
+    # Dispatch watchdog (docs/DEGRADED_MODE.md): per-window device
+    # deadline. None reads CKO_WINDOW_DEADLINE_S; unset = auto (~10x the
+    # warm p99 step latency, armed only once the engine is warmed and
+    # enough samples exist); <= 0 disables the watchdog.
+    window_deadline_s: float | None = None
     # First-evaluation budget while an engine's XLA executables are still
     # compiling (VERDICT r4 missing #2: request_timeout_s fired mid-compile
     # and the bulk path 500'd on a freshly started CRS-scale sidecar).
@@ -262,6 +272,7 @@ _CONTROL_PATHS = {
     API_PREFIX + "stats",
     API_PREFIX + "metrics",
     API_PREFIX + "rollback",
+    API_PREFIX + "quarantine/flush",
 }
 
 
@@ -446,6 +457,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_bulk(body)
             elif path == API_PREFIX + "rollback":
                 self._handle_rollback(body)
+            elif path == API_PREFIX + "quarantine/flush":
+                self._reply(*self.sidecar.quarantine_flush_reply(body))
             elif path.startswith(API_PREFIX):
                 self._reply_json(404, {"error": "not found"})
             else:
@@ -837,6 +850,70 @@ class TpuEngineSidecar:
         self.batcher.on_engine_success = (
             lambda _engine: self.degraded.record_device_success()
         )
+        # -- per-request fault isolation (docs/DEGRADED_MODE.md) ------------
+        # Request timeout: config field -> CKO_REQUEST_TIMEOUT_S -> 30.
+        # The resolved float is normalized back onto config so every
+        # reader (_timeout_for, the frontends) sees one value.
+        if config.request_timeout_s is None:
+            try:
+                config.request_timeout_s = float(
+                    os.environ.get("CKO_REQUEST_TIMEOUT_S", "") or 30.0
+                )
+            except ValueError:
+                config.request_timeout_s = 30.0
+        self.batcher.request_timeout_s = float(config.request_timeout_s)
+        # Dispatch watchdog: config field -> CKO_WINDOW_DEADLINE_S ->
+        # auto (None = ~10x warm p99 once warmed; <= 0 disables).
+        wd = config.window_deadline_s
+        if wd is None:
+            raw = os.environ.get("CKO_WINDOW_DEADLINE_S", "")
+            if raw:
+                try:
+                    wd = float(raw)
+                except ValueError:
+                    wd = None
+        config.window_deadline_s = wd
+        self.batcher.window_deadline_s = wd
+        # Poison quarantine: offenders isolated by the bisector are
+        # routed to host fallback at batch-assembly time. Window faults
+        # feed the breaker provisionally (prompt demotion under a real
+        # storm); a successful isolation proved the device healthy on
+        # other traffic, so it forgives the failure — the breaker stays
+        # closed under a poison storm.
+        self.quarantine = QuarantineRegistry()
+        self.bisector = PoisonBisector(
+            self.quarantine,
+            on_isolated=self.degraded.record_device_success,
+        )
+        self.bisector.start()
+        self.batcher.quarantine = self.quarantine
+        self.batcher.fallback_evaluate = self._drain_evaluate
+        self.batcher.on_window_fault = self._on_window_fault
+        self.metrics.gauge(
+            "cko_windows_abandoned_total",
+            "Windows abandoned by the dispatch watchdog (deadline blown;"
+            " futures re-answered by host fallback)",
+        ).set_function(lambda: float(self.batcher.windows_abandoned))
+        self.metrics.gauge(
+            "cko_parked_readbacks",
+            "Stuck device readbacks parked on disposable worker threads",
+        ).set_function(lambda: float(self.batcher.parked_readbacks))
+        self.metrics.gauge(
+            "cko_collector_wedged",
+            "1 when the collect thread outlived its stop() join budget",
+        ).set_function(lambda: float(1 if self.batcher.collector_wedged else 0))
+        self.metrics.gauge(
+            "cko_quarantine_entries",
+            "Request fingerprints currently quarantined to host fallback",
+        ).set_function(lambda: float(len(self.quarantine)))
+        self.metrics.gauge(
+            "cko_quarantine_hits_total",
+            "Requests routed to host fallback by a quarantine match",
+        ).set_function(lambda: float(self.quarantine.hits_total))
+        self.metrics.gauge(
+            "cko_quarantine_isolated_total",
+            "Poison requests isolated by the window bisector",
+        ).set_function(lambda: float(self.quarantine.isolated_total))
         # Graceful drain: windows still queued at stop() are EVALUATED
         # (host fallback when available) within the drain budget instead
         # of failing — an accepted request never loses its verdict.
@@ -1015,6 +1092,38 @@ class TpuEngineSidecar:
             return self.degraded.fallback_evaluate(engine, requests)
         return engine.evaluate(requests)
 
+    def _on_window_fault(self, engine, err, requests_fn) -> None:
+        """Device-window fault taxonomy (docs/DEGRADED_MODE.md):
+
+        1. loss-class errors (DEVICE_LOST markers) go to the device-loss
+           manager — arrays are invalid, the breaker's retry-same-arrays
+           probe would be wrong;
+        2. every other fault feeds the breaker IMMEDIATELY (prompt,
+           synchronous demotion under a real device storm — the
+           pre-quarantine timing), and, when the faulted window's
+           requests are available, is ALSO handed to the poison
+           bisector: a successful isolation quarantines the offender(s)
+           and forgives the provisional failure (the device was proven
+           healthy on other traffic), so a poison storm never walks the
+           breaker open;
+        3. late-classified faults with no requests (a parked readback
+           completing after abandonment) only run the loss check — the
+           abandonment itself already fed the breaker.
+        """
+        if is_device_loss(err):
+            self.degraded.record_device_failure(err)
+            return
+        if requests_fn is None:
+            return
+        self.degraded.record_device_failure(err)
+        try:
+            requests = requests_fn()
+        except Exception as mat_err:
+            log.error("fault window materialization failed", mat_err)
+            return
+        if requests:
+            self.bisector.submit(engine, err, requests)
+
     # -- crash-safe warm restart (docs/RECOVERY.md) --------------------------
 
     def _persist_state(self) -> None:
@@ -1162,13 +1271,29 @@ class TpuEngineSidecar:
             )
         return _json_reply(200, {**result, "mode": self.serving_mode(tenant)})
 
+    def quarantine_flush_reply(self, body: bytes) -> tuple[int, bytes, dict]:
+        """Drop every quarantined fingerprint (operator escape hatch:
+        a fixed ruleset or fixed upstream makes old offenders clean
+        again before their TTL runs out). Body is accepted and ignored
+        for forward compatibility."""
+        del body
+        flushed = self.quarantine.flush()
+        log.info("quarantine flushed", flushed=flushed)
+        return _json_reply(
+            200, {"flushed": flushed, "entries": len(self.quarantine)}
+        )
+
     def overloaded_reply(
         self, err: Overloaded, as_json: bool
     ) -> tuple[int, bytes, dict]:
         retry = max(1, int(err.retry_after_s + 0.999))
         if as_json:
+            # Header parity with the filter-mode branch below: shed
+            # responses carry the action taxonomy on BOTH surfaces.
             return _json_reply(
-                429, {"error": f"overloaded: {err}"}, {"Retry-After": str(retry)}
+                429,
+                {"error": f"overloaded: {err}"},
+                {"Retry-After": str(retry), "x-waf-action": "shed"},
             )
         return (
             429,
@@ -1446,15 +1571,26 @@ class TpuEngineSidecar:
         self.record_window(engine, blob, verdicts)
         return [verdict_to_json(v) for v in verdicts]
 
-    def record_window(self, engine, blob: bytes, verdicts: list[Verdict]) -> None:
+    def count_window(self, verdicts: list[Verdict]) -> None:
+        """Verdict counters for a blob-backed window. Split out of
+        ``record_window`` so the async frontend can increment them
+        BEFORE the replies leave the loop thread — a client that reads
+        its 200 and immediately scrapes ``/waf/v1/metrics`` must see its
+        own request counted (the audit half stays off the loop)."""
+        n_deny = sum(1 for v in verdicts if v.interrupted)
+        self._m_requests.inc(n_deny, action="deny")
+        self._m_requests.inc(len(verdicts) - n_deny, action="allow")
+
+    def record_window(
+        self, engine, blob: bytes, verdicts: list[Verdict], counted: bool = False
+    ) -> None:
         """Batch accounting for blob-backed windows (bulk fast path and
         async-ingest filter windows): metrics in two increments, audit
         posture IDENTICAL to the per-request ``record_verdict`` path
         (ADVICE r3) — ``AuditLogger``'s relevant_only setting decides,
         with request lines recovered from the native request blob."""
-        n_deny = sum(1 for v in verdicts if v.interrupted)
-        self._m_requests.inc(n_deny, action="deny")
-        self._m_requests.inc(len(verdicts) - n_deny, action="allow")
+        if not counted:
+            self.count_window(verdicts)
         if self.audit is None:
             return
         from ..native import blob_request_lines
@@ -1629,6 +1765,19 @@ class TpuEngineSidecar:
                     raise
         return out
 
+    def _effective_deadline(self) -> float | None:
+        """The watchdog deadline currently armed for the default
+        tenant's engine (None = off: cold engine, too few samples, or
+        explicitly disabled). Surfaced so operators and the chaos
+        harness can size their expectations."""
+        engine = self.tenants.engine_for(None)
+        if engine is None:
+            return None
+        try:
+            return self.batcher._window_deadline_for(engine)
+        except Exception:
+            return None
+
     def _compile_report_len(self, field: str) -> int:
         engine = self.tenants.engine_for(None)
         if engine is None:
@@ -1656,6 +1805,19 @@ class TpuEngineSidecar:
                 "depth": self.batcher.pipeline_depth,
                 "inflight_windows": self.batcher.inflight_windows(),
             },
+            "watchdog": {
+                "window_deadline_s": self.config.window_deadline_s,
+                "effective_deadline_s": self._effective_deadline(),
+                "windows_abandoned": self.batcher.windows_abandoned,
+                "parked_readbacks": self.batcher.parked_readbacks,
+                "collector_wedged": self.batcher.collector_wedged,
+            },
+            "quarantine": {
+                **self.quarantine.stats(),
+                "bisect_jobs": self.bisector.jobs_total,
+                "bisect_dropped": self.bisector.jobs_dropped,
+            },
+            "request_timeout_s": self.config.request_timeout_s,
             "tenants": self.tenants.stats(),
             "reloads": self.tenants.total_reloads,
             "failed_reloads": self.tenants.total_failed_reloads,
@@ -1775,6 +1937,7 @@ class TpuEngineSidecar:
                 self._serve_thread.join(timeout=10)
             self._httpd.server_close()
         self.degraded.stop()
+        self.bisector.stop()
         if self.rollout is not None:
             self.rollout.stop()
         self.batcher.stop()

@@ -30,6 +30,16 @@ Knobs (all default off):
   device loss the runtime recovers from once the sidecar re-puts its
   arrays on a fresh backend (docs/RECOVERY.md device-loss state
   machine). Changing the knob's value re-arms the countdown.
+- ``CKO_FAULT_POISON_MARKER=<bytes>``: a device dispatch raises
+  :class:`DeviceFault` iff any request body in the window contains this
+  marker — the deterministic "poison request" the quarantine bisector
+  (``sidecar/quarantine.py``) isolates. Unlike the rate knob, clean
+  windows are untouched, so the blast radius is exactly the marked
+  requests.
+- ``CKO_FAULT_DEVICE_HANG_S=<seconds>``: the NEXT device readback
+  (``WafEngine.collect``) sleeps this long before returning — a one-shot
+  hung execution the dispatch watchdog must abandon. Changing the
+  knob's value re-arms the shot.
 - ``CKO_FAULT_SHADOW_DIVERGE_RATE=<0..1>``: each shadow-verification
   window of a staged rollout (``sidecar/rollout.py``) is forced to read
   as diverged with this probability — simulating a
@@ -158,6 +168,45 @@ def injected_device_error() -> bool:
     rng = _error_rng()
     with _rng_lock:
         return rng.random() < rate
+
+
+def poison_marker() -> bytes | None:
+    """The poison byte-marker, or None when the knob is unset
+    (``CKO_FAULT_POISON_MARKER``). Engines fault a window iff any live
+    request body contains the marker — the quarantine bisector's
+    deterministic offender."""
+    raw = os.environ.get("CKO_FAULT_POISON_MARKER", "")
+    if not raw:
+        return None
+    return raw.encode("utf-8", "surrogateescape")
+
+
+_hang_lock = threading.Lock()
+_hang_armed: str | None = None
+_hang_fired = False
+
+
+def injected_device_hang_s() -> float:
+    """One-shot readback hang (``CKO_FAULT_DEVICE_HANG_S``): the first
+    call after the knob is set (or its value changes — re-arming works
+    like ``CKO_FAULT_DEVICE_LOST_N``) returns the hang duration; every
+    later call returns 0 until re-armed."""
+    global _hang_armed, _hang_fired
+    raw = os.environ.get("CKO_FAULT_DEVICE_HANG_S", "")
+    with _hang_lock:
+        if raw != _hang_armed:
+            _hang_armed = raw
+            _hang_fired = False
+        if _hang_fired:
+            return 0.0
+        try:
+            s = float(raw or 0)
+        except ValueError:
+            s = 0.0
+        if s > 0:
+            _hang_fired = True
+            return s
+    return 0.0
 
 
 def on_device_dispatch(warmed: bool) -> None:

@@ -37,6 +37,18 @@ the fault-injection harness (``testing/faults.py``) end to end:
    verdicts stay correct throughout (fallback rescue, readyz green),
    the loss is counted in ``cko_device_lost_total``, and the bounded
    re-init loop recovers device serving.
+9. **poison storm + dispatch watchdog** (ISSUE 13) — 5% of traffic is
+   one repeated poison request (``CKO_FAULT_POISON_MARKER``) that
+   faults any device window containing it, plus one injected device
+   hang (``CKO_FAULT_DEVICE_HANG_S``) mid-run: every response is the
+   correct verdict (poison answered from host fallback), the bisector
+   isolates and quarantines the offender
+   (``cko_quarantine_isolated_total``), repeats are assembly-routed
+   (``cko_quarantine_hits_total``), the hung window is abandoned and
+   re-answered within 2x the window deadline
+   (``cko_windows_abandoned_total``), the breaker NEVER opens, serving
+   stays ``promoted`` for >= 90% of the run, and
+   ``POST /waf/v1/quarantine/flush`` drains the registry.
 
 Throughout, a background traffic storm asserts every response is a real
 verdict (200/403, correct per request) — never a blank 500 — and at the
@@ -90,8 +102,10 @@ def _fail(stage: str, **detail) -> int:
     return 1
 
 
-def _http(port, path, timeout=30):
-    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+def _http(port, path, timeout=30, method="GET", data=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method, data=data
+    )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.read()
@@ -461,6 +475,138 @@ def main() -> int:
                 "device_lost_recovery", detail=f"post-recovery verdict {status}"
             )
 
+        # 9. Poison storm + dispatch watchdog (ISSUE 13): 5% of traffic
+        # is ONE repeated poison request that faults any device window
+        # containing it. The bisector must isolate and quarantine it
+        # (clean traffic stays on device, the breaker never opens), and
+        # a one-shot injected device hang mid-run must be abandoned by
+        # the watchdog and re-answered from fallback within 2x the
+        # window deadline.
+        wd_deadline = 1.5
+        sc2.config.window_deadline_s = wd_deadline
+        sc2.batcher.window_deadline_s = wd_deadline
+        opened_before = sc2.degraded.breaker.opened_total
+        abandoned_before = sc2.batcher.windows_abandoned
+        q_before = sc2.quarantine.stats()
+        os.environ["CKO_FAULT_POISON_MARKER"] = "POISON-9"
+        poison_bad = []
+        mode_samples = 0
+        mode_promoted = 0
+        hang_fired = False
+        hang_answer_s = None
+        t_poison = time.monotonic()
+        i = 0
+        while True:
+            elapsed = time.monotonic() - t_poison
+            if elapsed >= 60:
+                break
+            q_now = sc2.quarantine
+            if (
+                elapsed >= 20
+                and hang_fired
+                and q_now.isolated_total > q_before["isolated_total"]
+                and q_now.hits_total > q_before["hits_total"]
+                and sc2.batcher.windows_abandoned > abandoned_before
+            ):
+                break  # every gate observed; no need to run the full hour
+            if elapsed >= 10 and not hang_fired:
+                # One-shot device hang, well past the window deadline:
+                # the next device window must be abandoned and its
+                # request re-answered from fallback, promptly. The hang
+                # fires on whichever collect runs next — a concurrent
+                # bisection sub-dispatch can steal it, so re-arm (the
+                # knob re-arms on value change) until the probe's own
+                # window is the one abandoned.
+                hang_fired = True
+                for hang_val in ("4.0", "4.25", "4.5"):
+                    os.environ["CKO_FAULT_DEVICE_HANG_S"] = hang_val
+                    t0 = time.monotonic()
+                    status, body = _http(sc2.port, "/?q=hangprobe")
+                    hang_answer_s = time.monotonic() - t0
+                    if status != 200 or not body:
+                        poison_bad.append(("hangprobe", status, body[:80]))
+                        break
+                    if sc2.batcher.windows_abandoned > abandoned_before:
+                        if hang_answer_s > 2 * wd_deadline + 2.0:
+                            poison_bad.append(("hangprobe_slow", hang_answer_s))
+                        break
+                os.environ.pop("CKO_FAULT_DEVICE_HANG_S", None)
+            if i % 20 == 5:
+                # The poison: identical every time (same fingerprint),
+                # and it matches rule 3001 — the fallback must produce
+                # the RIGHT verdict, not just any verdict.
+                path = "/?pet=evilmonkey&poison=1"
+                status, body = _http(
+                    sc2.port, path, method="POST", data=b"q=POISON-9"
+                )
+                want = 403
+            else:
+                attack = i % 2 == 0
+                path = f"/?pet=evilmonkey&p9={i}" if attack else f"/?q=fine&p9={i}"
+                status, body = _http(sc2.port, path)
+                want = 403 if attack else 200
+            if status != want or not body:
+                poison_bad.append((path, status, body[:80]))
+            mode = sc2.serving_mode()
+            mode_samples += 1
+            if mode == "promoted":
+                mode_promoted += 1
+            if mode == "broken":
+                poison_bad.append(("mode_broken", i))
+            i += 1
+            time.sleep(0.005)
+        del os.environ["CKO_FAULT_POISON_MARKER"]
+        os.environ.pop("CKO_FAULT_DEVICE_HANG_S", None)
+        promoted_fraction = mode_promoted / max(1, mode_samples)
+        if poison_bad:
+            return _fail(
+                "poison_storm", bad=poison_bad[:5], total=len(poison_bad)
+            )
+        if sc2.batcher.windows_abandoned <= abandoned_before:
+            return _fail("poison_storm", detail="hung window never abandoned")
+        if not _wait(lambda: sc2.batcher.parked_readbacks == 0, 30):
+            return _fail(
+                "poison_storm",
+                detail="parked readback never returned",
+                parked=sc2.batcher.parked_readbacks,
+            )
+        q_stats = sc2.quarantine.stats()
+        if q_stats["isolated_total"] <= q_before["isolated_total"]:
+            return _fail("poison_storm", detail="poison never isolated", q=q_stats)
+        if q_stats["hits_total"] <= q_before["hits_total"]:
+            return _fail(
+                "poison_storm", detail="quarantine never routed a repeat", q=q_stats
+            )
+        if sc2.degraded.breaker.opened_total != opened_before:
+            return _fail(
+                "poison_storm",
+                detail="breaker opened during poison storm",
+                breaker=sc2.degraded.breaker.snapshot(),
+            )
+        if promoted_fraction < 0.9:
+            return _fail(
+                "poison_storm",
+                detail="device path demoted too long",
+                promoted_fraction=round(promoted_fraction, 3),
+            )
+        if not _wait(lambda: sc2.serving_mode() == "promoted", 60):
+            return _fail("poison_storm", detail="not promoted at end")
+        status, body = _http(
+            sc2.port, "/waf/v1/quarantine/flush", method="POST", data=b""
+        )
+        if status != 200:
+            return _fail("poison_storm", detail=f"flush answered {status}")
+        flushed = json.loads(body)
+        if flushed.get("flushed", 0) < 1 or flushed.get("entries") != 0:
+            return _fail("poison_storm", detail="flush did not drain", got=flushed)
+        poison_summary = {
+            "windows_abandoned": sc2.batcher.windows_abandoned - abandoned_before,
+            "isolated": q_stats["isolated_total"] - q_before["isolated_total"],
+            "hits": q_stats["hits_total"] - q_before["hits_total"],
+            "promoted_fraction": round(promoted_fraction, 3),
+            "hang_answer_s": round(hang_answer_s, 3) if hang_answer_s else None,
+        }
+
         if sc.serving_mode() not in ("promoted", "fallback"):
             return _fail("final_mode", mode=sc.serving_mode())
         if not _wait(lambda: sc.batcher.inflight_windows() == 0, 30):
@@ -514,6 +660,7 @@ def main() -> int:
                 "ingress": sc.governor.stats(),
                 "restart_ready_s": round(ready_s, 3),
                 "device_loss": dl.stats(),
+                "poison": poison_summary,
             }
         )
     )

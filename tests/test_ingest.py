@@ -420,6 +420,40 @@ def test_window_shedding_429(engine):
         sc.stop()
 
 
+def test_429_shed_header_parity_both_frontends(engine):
+    """Every 429 shed reply carries Retry-After AND x-waf-action: shed
+    on BOTH frontends — the filter path and the JSON bulk path (whose
+    as_json branch previously dropped the action header)."""
+    payload = json.dumps({"requests": [{"uri": "/?q=ok"}]}).encode()
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(
+            engine,
+            frontend=frontend,
+            queue_budget=8,
+            shed_retry_after_s=2.0,
+            # Tenant routing disables the native bulk fast path, which
+            # bypasses the batcher (and so its backlog signal) by design.
+            trust_tenant_header=True,
+        )
+        sc.start()
+        try:
+            assert _wait(sc.ready)
+            assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
+            sc.batcher.pending = lambda: 100  # simulated backlog over budget
+            status, headers, _ = _http(sc.port, "/?q=ok")
+            assert status == 429, frontend
+            assert headers["Retry-After"] == "2", (frontend, headers)
+            assert headers["x-waf-action"] == "shed", (frontend, headers)
+            status, headers, body = _http(
+                sc.port, "/waf/v1/evaluate", method="POST", body=payload
+            )
+            assert status == 429, (frontend, body)
+            assert headers["Retry-After"] == "2", (frontend, headers)
+            assert headers["x-waf-action"] == "shed", (frontend, headers)
+        finally:
+            sc.stop()
+
+
 # -- control endpoints --------------------------------------------------------
 
 
