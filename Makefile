@@ -75,6 +75,14 @@ restart.smoke:  ## Crash-safe warm restart across a real process boundary: SIGKI
 compile.smoke:  ## Cold-compile ceiling gate: crs-lite wall + minimized-state + signature caps.
 	$(PYTHON) hack/compile_time_smoke.py
 
+.PHONY: trace.smoke
+trace.smoke:  ## Flight-recorder gate: sampling off vs on within 5% req/s, complete span chains per serving path.
+	$(PYTHON) hack/trace_smoke.py
+
+.PHONY: metrics.lint
+metrics.lint:  ## Metric catalog drift: every registered cko_*/waf_* metric documented, no dead doc entries.
+	$(PYTHON) hack/metrics_lint.py
+
 # bench.warm populates .jax_bench_cache with the FINAL compiler's HLO so
 # the driver's timed run hits a warm XLA cache (VERDICT r3 item 1d). Runs
 # every config once with minimal iters; throughput output is discarded.

@@ -246,6 +246,30 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         help="fraction of live windows mirrored through a staged"
         " candidate (default $CKO_SHADOW_SAMPLE_RATE or 1.0)",
     )
+    p.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        default=None,
+        help="flight-recorder sampling (docs/OBSERVABILITY.md): fraction"
+        " of requests without a traceparent header that are traced"
+        " end-to-end; requests carrying the header are always recorded"
+        " when > 0 (default $CKO_TRACE_SAMPLE_RATE or 0 = off)",
+    )
+    p.add_argument(
+        "--trace-ring",
+        type=int,
+        default=None,
+        help="max completed traces retained for GET /waf/v1/trace"
+        " (default $CKO_TRACE_RING or 512)",
+    )
+    p.add_argument(
+        "--audit-max-bytes",
+        type=int,
+        default=None,
+        help="audit-log size cap: keep-1 rotation to <path>.1 once the"
+        " live file would exceed this many bytes (default"
+        " $CKO_AUDIT_MAX_BYTES or 0 = unbounded; file-backed logs only)",
+    )
     args = p.parse_args(argv)
 
     # Wire the persistent compile cache BEFORE any engine compiles: a
@@ -293,6 +317,9 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         body_timeout_s=args.body_timeout_seconds,
         max_body_bytes=args.max_body_bytes,
         ingress_memory_budget_bytes=args.ingress_memory_budget_bytes,
+        trace_sample_rate=args.trace_sample_rate,
+        trace_ring=args.trace_ring,
+        audit_max_bytes=args.audit_max_bytes,
     )
 
 

@@ -12,5 +12,23 @@ ids that go-ftw-style log assertions grep for.
 
 from .audit import AuditLogger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import (
+    SpanContext,
+    TraceRecorder,
+    derive_span_id,
+    format_traceparent,
+    parse_traceparent,
+)
 
-__all__ = ["AuditLogger", "Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = [
+    "AuditLogger",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanContext",
+    "TraceRecorder",
+    "derive_span_id",
+    "format_traceparent",
+    "parse_traceparent",
+]

@@ -13,6 +13,7 @@ negligible next to a device batch step.
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 
 
@@ -140,8 +141,11 @@ class Histogram(_Metric):
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
         self._totals: dict[tuple[str, ...], int] = {}
+        # (key, bucket index) -> (trace_id, value, unix_ts). Last write
+        # wins per bucket — an exemplar is a pointer, not a log.
+        self._exemplars: dict[tuple[tuple[str, ...], int], tuple[str, float, float]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None, **labels) -> None:
         key = tuple(str(labels.get(k, "")) for k in self.label_names)
         idx = bisect_left(self.buckets, value)
         with self._lock:
@@ -150,6 +154,17 @@ class Histogram(_Metric):
                 counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar:
+                self._exemplars[(key, idx)] = (exemplar, value, time.time())
+
+    @staticmethod
+    def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
+        """OpenMetrics exemplar rendered after a bucket's value:
+        ``# {trace_id="..."} <value> <timestamp>``."""
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return f' # {{trace_id="{_escape(trace_id)}"}} {_fmt_value(value)} {ts:.3f}'
 
     def render(self) -> list[str]:
         with self._lock:
@@ -158,16 +173,23 @@ class Histogram(_Metric):
                 k: (list(self._counts[k]), self._sums[k], self._totals[k])
                 for k in keys
             }
+            exemplars = dict(self._exemplars)
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for key, (counts, total_sum, total) in snapshot.items():
             cum = 0
-            for le, c in zip(self.buckets, counts):
+            for i, (le, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 lk = self.label_names + ("le",)
                 lv = key + (_fmt_value(le),)
-                lines.append(f"{self.name}_bucket{_fmt_labels(lk, lv)} {cum}")
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(lk, lv)} {cum}"
+                    f"{self._exemplar_suffix(exemplars.get((key, i)))}"
+                )
             lk = self.label_names + ("le",)
-            lines.append(f"{self.name}_bucket{_fmt_labels(lk, key + ('+Inf',))} {total}")
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(lk, key + ('+Inf',))} {total}"
+                f"{self._exemplar_suffix(exemplars.get((key, len(self.buckets))))}"
+            )
             lines.append(
                 f"{self.name}_sum{_fmt_labels(self.label_names, key)} {_fmt_value(total_sum)}"
             )

@@ -72,11 +72,11 @@ class BatcherStats:
     # enqueue) vs device step (readback block + decode) per window group.
     host_stage_s: list[float] = field(default_factory=list)
     device_stage_s: list[float] = field(default_factory=list)
-    on_batch: object = None  # optional (size, latency_s) hook for metrics
-    on_stage: object = None  # optional (host_s, device_s) hook for metrics
+    on_batch: object = None  # optional (size, latency_s, trace_id) hook for metrics
+    on_stage: object = None  # optional (host_s, device_s, trace_id) hook for metrics
     _max_samples: int = 4096
 
-    def record(self, size: int, latency_s: float) -> None:
+    def record(self, size: int, latency_s: float, trace_id: str | None = None) -> None:
         self.batches += 1
         self.requests += size
         if len(self.batch_sizes) >= self._max_samples:
@@ -85,16 +85,18 @@ class BatcherStats:
         self.batch_sizes.append(size)
         self.step_latencies_s.append(latency_s)
         if self.on_batch is not None:
-            self.on_batch(size, latency_s)  # type: ignore[operator]
+            self.on_batch(size, latency_s, trace_id)  # type: ignore[operator]
 
-    def record_stage(self, host_s: float, device_s: float) -> None:
+    def record_stage(
+        self, host_s: float, device_s: float, trace_id: str | None = None
+    ) -> None:
         if len(self.host_stage_s) >= self._max_samples:
             del self.host_stage_s[: self._max_samples // 2]
             del self.device_stage_s[: self._max_samples // 2]
         self.host_stage_s.append(host_s)
         self.device_stage_s.append(device_s)
         if self.on_stage is not None:
-            self.on_stage(host_s, device_s)  # type: ignore[operator]
+            self.on_stage(host_s, device_s, trace_id)  # type: ignore[operator]
 
     def snapshot(self) -> dict:
         lats = sorted(self.step_latencies_s)
@@ -149,12 +151,21 @@ class _BlobWindow:
     blob: bytes
     n_req: int
     fut: Future
+    # Flight-recorder contexts (observability/tracing.py), aligned with
+    # the blob's request index space; None (the steady state) or a list
+    # whose entries are SpanContext/None. Untraced windows pay one
+    # attribute read in the collect stage.
+    spans: list | None = None
 
 
 @dataclass
 class _WindowRecord:
-    window: object  # list of (req, tenant, fut) triples, or a _BlobWindow
+    window: object  # list of (req, tenant, fut, span) items, or a _BlobWindow
     groups: list
+    # Dispatch-stage entry time (after assembly + the depth-semaphore
+    # backpressure wait): the boundary between a traced request's
+    # "queue" and "assemble" spans.
+    t_win: float = 0.0
     # Blob window split by quarantine routing: groups carry idxs into the
     # blob's request index space and the collect stage stitches verdicts
     # back into one list for the window future.
@@ -220,7 +231,7 @@ class MicroBatcher:
             )
         self.pipeline_depth = max(1, int(pipeline_depth))
         self._queue: queue.Queue[
-            tuple[HttpRequest, str | None, Future] | None
+            tuple[HttpRequest, str | None, Future, object] | None
         ] = queue.Queue()
         self._inflight: queue.Queue[_WindowRecord | None] = queue.Queue()
         self._depth_sem = threading.Semaphore(self.pipeline_depth)
@@ -462,9 +473,11 @@ class MicroBatcher:
             _resolve(bw.fut.set_exception, EngineUnavailable("batcher stopped"))
 
     def _drain_triple(self, item) -> None:
-        req, tenant, fut = item
+        req, tenant, fut, span = item
         if fut.cancelled():
             return
+        if span is not None:
+            span.annotate_path("drained")
         verdicts = self._drain_eval([req], tenant)
         if verdicts is not None:
             self.drained_requests += 1
@@ -473,24 +486,35 @@ class MicroBatcher:
             self.drain_failed += 1
             _resolve(fut.set_exception, EngineUnavailable("batcher stopped"))
 
-    def submit(self, request: HttpRequest, tenant: str | None = None) -> Future:
-        """Enqueue one request; the Future resolves to its Verdict."""
+    def submit(
+        self,
+        request: HttpRequest,
+        tenant: str | None = None,
+        span=None,
+    ) -> Future:
+        """Enqueue one request; the Future resolves to its Verdict.
+        ``span`` is an optional flight-recorder SpanContext; the collect
+        stage stamps the pipeline spans onto it before the future
+        resolves."""
         fut: Future = Future()
-        self._queue.put((request, tenant, fut))
+        if span is not None:
+            span.t_submit = time.monotonic()
+        self._queue.put((request, tenant, fut, span))
         return fut
 
-    def submit_window(self, blob: bytes, n_req: int) -> Future:
+    def submit_window(self, blob: bytes, n_req: int, spans=None) -> Future:
         """Enqueue a pre-assembled ingest window (request blob in the
         ``native.serialize_requests`` format). Dispatched as its own
         window — never coalesced with per-request submissions — on the
         default tenant's engine pinned at dispatch time (reload-safe
         draining, same as per-request windows). The Future resolves to
-        the window's ``list[Verdict]``."""
+        the window's ``list[Verdict]``. ``spans`` optionally carries one
+        flight-recorder context per blob request index (or None)."""
         fut: Future = Future()
         with self._inflight_lock:
             self._blob_pending += n_req
             self._blob_pending_bytes += len(blob)
-        self._queue.put(_BlobWindow(blob=blob, n_req=n_req, fut=fut))
+        self._queue.put(_BlobWindow(blob=blob, n_req=n_req, fut=fut, spans=spans))
         return fut
 
     def pending(self) -> int:
@@ -514,10 +538,13 @@ class MicroBatcher:
         request: HttpRequest,
         timeout_s: float | None = None,
         tenant: str | None = None,
+        span=None,
     ) -> Verdict:
         if timeout_s is None:
             timeout_s = self.request_timeout_s
-        return self.submit(request, tenant=tenant).result(timeout=timeout_s)
+        return self.submit(request, tenant=tenant, span=span).result(
+            timeout=timeout_s
+        )
 
     # -- dispatch stage ------------------------------------------------------
 
@@ -599,8 +626,9 @@ class MicroBatcher:
         self._inflight.put(record)
 
     def _dispatch_window(
-        self, window: list[tuple[HttpRequest, str | None, Future]]
+        self, window: list[tuple[HttpRequest, str | None, Future, object]]
     ) -> _WindowRecord:
+        t_win = time.monotonic()
         # Group the window by the tenant's COMPILED MODEL, not by tenant
         # name: tenants typically fork a few base policies, so windows
         # touching many tenants still coalesce into one device step per
@@ -619,7 +647,7 @@ class MicroBatcher:
         # tenant-manager lock); memoizing also pins one engine per tenant
         # for the whole window even if a hot reload lands mid-grouping.
         tenant_cache: dict[str | None, WafEngine | None] = {}
-        for idx, (_req, tenant, _fut) in enumerate(window):
+        for idx, (_req, tenant, _fut, _span) in enumerate(window):
             if _fut.cancelled():
                 # Deadline-missed request already answered by the host
                 # fallback — don't spend a device slot on it.
@@ -632,7 +660,7 @@ class MicroBatcher:
                 continue
             key = id(engine)
             group_engine[key] = engine
-            if registry is not None and registry.match(_req):
+            if registry is not None and registry.match(_req, span=_span):
                 # Quarantined poison: answered by host fallback in the
                 # collect stage — it never rides a device window again.
                 quarantined.setdefault(key, []).append(idx)
@@ -678,13 +706,14 @@ class MicroBatcher:
             except Exception as err:  # dispatch failure → per-request error
                 g.error = err
             out_groups.append(g)
-        return _WindowRecord(window=window, groups=out_groups)
+        return _WindowRecord(window=window, groups=out_groups, t_win=t_win)
 
     def _dispatch_blob(self, bw: _BlobWindow) -> _WindowRecord:
         """Dispatch a pre-assembled ingest window: one engine (default
         tenant, pinned here — a reload lands on the NEXT window), one
         ``prepare_blob`` call. Engines without the blob API (test stubs)
         materialize the requests and evaluate synchronously."""
+        t_win = time.monotonic()
         engine = self._engine_fn(None)
         registry = self.quarantine
         if engine is not None and registry is not None and len(registry):
@@ -716,7 +745,7 @@ class MicroBatcher:
                         g.verdicts = engine.evaluate(reqs)
             except Exception as err:
                 g.error = err
-        return _WindowRecord(window=bw, groups=[g])
+        return _WindowRecord(window=bw, groups=[g], t_win=t_win)
 
     def _dispatch_blob_split(
         self, bw: _BlobWindow, engine, registry
@@ -731,7 +760,14 @@ class MicroBatcher:
         from ..native import blob_requests
 
         reqs = blob_requests(bw.blob, bw.n_req)
-        qidx = [i for i, r in enumerate(reqs) if registry.match(r)]
+        spans = bw.spans
+        qidx = [
+            i
+            for i, r in enumerate(reqs)
+            if registry.match(
+                r, span=spans[i] if spans and i < len(spans) else None
+            )
+        ]
         if not qidx:
             return None
         qset = set(qidx)
@@ -763,7 +799,9 @@ class MicroBatcher:
                 reqs=[reqs[i] for i in qidx],
             )
         )
-        return _WindowRecord(window=bw, groups=groups, split=True)
+        return _WindowRecord(
+            window=bw, groups=groups, split=True, t_win=time.monotonic()
+        )
 
     # -- collect stage -------------------------------------------------------
 
@@ -787,7 +825,7 @@ class MicroBatcher:
                     if not record.window.fut.done():
                         _resolve(record.window.fut.set_exception, err)
                 else:
-                    for _req, _tenant, fut in record.window:
+                    for _req, _tenant, fut, _span in record.window:
                         if not fut.done():
                             _resolve(fut.set_exception, err)
             finally:
@@ -898,6 +936,73 @@ class MicroBatcher:
             raise job.error
         return job.verdicts
 
+    # -- flight recorder (observability/tracing.py) --------------------------
+
+    def _group_spans(self, record: _WindowRecord, g: _Group) -> tuple:
+        """Recording SpanContexts for one group's requests. Empty (the
+        steady state) when the window carries no traced requests."""
+        if isinstance(record.window, _BlobWindow):
+            spans = record.window.spans
+            if not spans:
+                return ()
+            out = []
+            for i in g.idxs if g.idxs else range(record.window.n_req):
+                s = spans[i] if i < len(spans) else None
+                if s is not None and s.recording:
+                    out.append(s)
+            return tuple(out)
+        out = []
+        for i in g.idxs:
+            s = record.window[i][3]
+            if s is not None and s.recording:
+                out.append(s)
+        return tuple(out)
+
+    def _trace_group(self, record: _WindowRecord, g: _Group, spans: tuple) -> None:
+        """Stamp the pipeline span chain (queue -> assemble -> dispatch
+        -> readback -> decode) onto a collected group's traced requests.
+        Must run BEFORE the group's futures resolve — the frontend
+        commits the flight record when its future lands. Sync groups
+        (stub engines, phase-split) have no stage timings; their device
+        spans degenerate to zero length but the chain stays complete."""
+        try:
+            t_end = time.monotonic()
+            inflight = g.inflight
+            host_s = getattr(inflight, "host_s", 0.0) if inflight is not None else 0.0
+            device_s = getattr(inflight, "device_s", 0.0) if inflight is not None else 0.0
+            decode_s = getattr(inflight, "decode_s", 0.0) if inflight is not None else 0.0
+            t_win = record.t_win or g.t_dispatch
+            t_disp = g.t_dispatch
+            t_host1 = min(t_end, t_disp + host_s)
+            t_rb0 = max(t_host1, t_end - device_s - decode_s)
+            t_rb1 = max(t_rb0, t_end - decode_s)
+            n = len(g.idxs) if g.idxs else getattr(record.window, "n_req", 0)
+            for span in spans:
+                t_sub = span.t_submit or span.t_accept
+                span.event("queue", min(t_sub, t_win), t_win, track="pipeline")
+                span.event(
+                    "assemble", t_win, t_disp, track="pipeline", args={"window": n}
+                )
+                span.event("dispatch", t_disp, t_host1, track="pipeline")
+                span.event("readback", t_rb0, t_rb1, track="device")
+                span.event("decode", t_rb1, t_end, track="device")
+        except Exception as err:  # tracing must never decide a verdict
+            log.error("flight recorder stamp failed", err)
+
+    def _trace_degraded(
+        self, record: _WindowRecord, g: _Group, path: str, name: str
+    ) -> None:
+        """Tag a group's traced requests with a degraded branch (event
+        on the degraded track + path annotation) before their futures
+        resolve/fail."""
+        try:
+            t_end = time.monotonic()
+            for span in self._group_spans(record, g):
+                span.annotate_path(path)
+                span.event(name, g.t_dispatch, t_end, track="degraded")
+        except Exception as err:
+            log.error("flight recorder stamp failed", err)
+
     def _window_fault(self, g: _Group, requests_fn) -> None:
         """Classify a device-window fault. ``on_window_fault`` (the
         sidecar's taxonomy: loss-class -> DeviceLossManager, else
@@ -925,6 +1030,7 @@ class MicroBatcher:
     def _collect_quarantined(self, record: _WindowRecord, g: _Group) -> None:
         """Resolve a quarantined group's futures from host fallback —
         no breaker traffic, no device stats, no shadow mirror."""
+        self._trace_degraded(record, g, "quarantine", "quarantine")
         try:
             verdicts = self._quarantine_eval(g)
         except Exception as err:
@@ -954,6 +1060,7 @@ class MicroBatcher:
                     # Missing-engine group: a routing condition, not a
                     # device failure — never feeds the breaker.
                     self.stats.errors += len(g.idxs)
+                    self._trace_degraded(record, g, "unavailable", "unavailable")
                     for i in g.idxs:
                         _resolve(record.window[i][2].set_exception, g.error)
                     continue
@@ -962,10 +1069,15 @@ class MicroBatcher:
                 self._window_fault(
                     g, lambda g=g: [record.window[i][0] for i in g.idxs]
                 )
+                if isinstance(g.error, WindowAbandoned):
+                    self._trace_degraded(record, g, "abandoned", "abandon")
+                else:
+                    self._trace_degraded(record, g, "error", "window_error")
                 for i in g.idxs:
                     _resolve(record.window[i][2].set_exception, g.error)
                 continue
             self._notify(self.on_engine_success, g.engine)
+            spans = self._group_spans(record, g)
             # One stats sample per model group, recorded BEFORE the
             # futures resolve: a caller that reads /stats right after its
             # verdict lands must see its own request counted. Each group
@@ -974,17 +1086,23 @@ class MicroBatcher:
             # multi-tenant windows. Latency spans dispatch start ->
             # collect end: the true window residency a caller observes
             # under pipelining.
+            trace_id = spans[0].trace_id if spans else None
             try:
-                self.stats.record(len(g.idxs), time.monotonic() - g.t_dispatch)
+                self.stats.record(
+                    len(g.idxs), time.monotonic() - g.t_dispatch, trace_id
+                )
                 inflight = g.inflight
                 if inflight is not None:
                     self.stats.record_stage(
                         getattr(inflight, "host_s", 0.0),
                         getattr(inflight, "device_s", 0.0)
                         + getattr(inflight, "decode_s", 0.0),
+                        trace_id,
                     )
             except Exception as err:  # metrics hooks must not fail verdicts
                 log.error("batch stats hook failed", err)
+            if spans:
+                self._trace_group(record, g, spans)
             for i, verdict in zip(g.idxs, g.verdicts):
                 _resolve(record.window[i][2].set_result, verdict)
             if self.on_window is not None:
@@ -1024,9 +1142,17 @@ class MicroBatcher:
             if g.engine is not None:
                 log.error("blob window evaluation failed", g.error, batch=bw.n_req)
                 self._window_fault(g, lambda: _blob_requests_fn(bw))
+            if g.engine is None:
+                self._trace_degraded(record, g, "unavailable", "unavailable")
+            elif isinstance(g.error, WindowAbandoned):
+                self._trace_degraded(record, g, "abandoned", "abandon")
+            else:
+                self._trace_degraded(record, g, "error", "window_error")
             _resolve(bw.fut.set_exception, g.error)
             return
         self._notify(self.on_engine_success, g.engine)
+        spans = self._group_spans(record, g)
+        trace_id = spans[0].trace_id if spans else None
         inflight = g.inflight
         serving_s = (
             getattr(inflight, "host_s", 0.0)
@@ -1038,15 +1164,18 @@ class MicroBatcher:
         # Account BEFORE resolving: a caller that reads /stats right
         # after its verdict lands must see its own window counted.
         try:
-            self.stats.record(bw.n_req, time.monotonic() - g.t_dispatch)
+            self.stats.record(bw.n_req, time.monotonic() - g.t_dispatch, trace_id)
             if inflight is not None:
                 self.stats.record_stage(
                     getattr(inflight, "host_s", 0.0),
                     getattr(inflight, "device_s", 0.0)
                     + getattr(inflight, "decode_s", 0.0),
+                    trace_id,
                 )
         except Exception as err:  # metrics hooks must not fail verdicts
             log.error("batch stats hook failed", err)
+        if spans:
+            self._trace_group(record, g, spans)
         _resolve(bw.fut.set_result, list(g.verdicts))
         if self.on_window is not None and (
             self.window_wanted is None or self._wants_window(g.engine)
@@ -1076,6 +1205,7 @@ class MicroBatcher:
         for g in record.groups:
             try:
                 if g.quarantined:
+                    self._trace_degraded(record, g, "quarantine", "quarantine")
                     verdicts = self._quarantine_eval(g)
                 else:
                     if g.error is not None:
@@ -1095,12 +1225,17 @@ class MicroBatcher:
                 return
             if not g.quarantined:
                 self._notify(self.on_engine_success, g.engine)
+                spans = self._group_spans(record, g)
                 try:
                     self.stats.record(
-                        len(g.idxs), time.monotonic() - g.t_dispatch
+                        len(g.idxs),
+                        time.monotonic() - g.t_dispatch,
+                        spans[0].trace_id if spans else None,
                     )
                 except Exception as err:
                     log.error("batch stats hook failed", err)
+                if spans:
+                    self._trace_group(record, g, spans)
             for i, verdict in zip(g.idxs, verdicts):
                 out[i] = verdict
         _resolve(bw.fut.set_result, out)

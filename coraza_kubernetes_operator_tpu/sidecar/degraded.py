@@ -436,13 +436,25 @@ class DegradedModeManager:
             return "fallback"
         return "device"  # fallback disabled: legacy wait-out-the-compile path
 
-    def fallback_evaluate(self, engine, requests) -> list:
-        """Evaluate on the host fallback path (counts the requests)."""
+    def fallback_evaluate(self, engine, requests, span=None) -> list:
+        """Evaluate on the host fallback path (counts the requests).
+
+        ``span`` is an optional flight-recorder context
+        (observability/tracing.py): the fallback evaluation lands on its
+        degraded track and the serving path is tagged ``fallback`` —
+        the trace shows WHY a request skipped the device chain."""
         with self._lock:
             self.fallback_requests += len(requests)
         if self._on_fallback is not None:
             self._on_fallback(len(requests))
-        return engine.host_fallback.evaluate(requests)
+        if span is None:
+            return engine.host_fallback.evaluate(requests)
+        t0 = time.monotonic()
+        try:
+            return engine.host_fallback.evaluate(requests)
+        finally:
+            span.annotate_path("fallback")
+            span.event("fallback_eval", t0, time.monotonic(), track="degraded")
 
     # -- breaker feed --------------------------------------------------------
 

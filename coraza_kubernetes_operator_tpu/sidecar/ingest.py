@@ -89,6 +89,7 @@ _SPECIAL = {
     b"x-cko-deadline-ms",
     b"x-waf-tenant",
     b"authorization",
+    b"traceparent",
 }
 # Probe/operator targets that must stay answerable under memory
 # pressure: the byte-ledger shed never applies to them.
@@ -99,6 +100,8 @@ _CONTROL_TARGETS = {
     b"/waf/v1/metrics",
     b"/waf/v1/rollback",
     b"/waf/v1/quarantine/flush",
+    b"/waf/v1/trace",
+    b"/waf/v1/profile",
 }
 _pack = struct.pack
 
@@ -331,6 +334,11 @@ class AsyncIngestFrontend:
         # the hot path.
         self._win_buf = bytearray()
         self._win_futs: list[asyncio.Future] = []
+        # Flight-recorder contexts aligned with _win_futs. Lazily
+        # materialized: None until some request in the window is traced,
+        # so the sampling-off hot path never touches it.
+        self._win_traces: list | None = None
+        self._tracer = sidecar.tracer
         self._win_timer: asyncio.TimerHandle | None = None
         self._inflight_windows = 0
         # Counters (written on the loop thread; racy cross-thread reads
@@ -738,7 +746,9 @@ class AsyncIngestFrontend:
             pass
 
     def _render(self, status, payload, headers, keep_alive) -> bytes:
-        cacheable = len(payload) <= 256
+        # Traced responses carry a per-request traceparent header — they
+        # would fill the small-response cache with single-use entries.
+        cacheable = len(payload) <= 256 and "traceparent" not in headers
         if cacheable:
             key = (status, payload, tuple(headers.items()), keep_alive)
             cached = self._render_cache.get(key)
@@ -780,10 +790,25 @@ class AsyncIngestFrontend:
     def _route(self, method, target, version, pairs, special, body, remote_b):
         sc = self.sidecar
         target_s = target.decode("latin-1", "replace")
-        path = target_s.split("?", 1)[0]
+        path, _, query = target_s.partition("?")
         if path.startswith(API_PREFIX):
-            return self._route_api(method, path, special, body)
+            return self._route_api(method, path, special, body, query)
         # -- filter mode ------------------------------------------------------
+        # Flight recorder: one dict probe + one attribute read when off
+        # and no header — the zero-hot-path-cost contract. The span (when
+        # any) rides the window into the batcher and is committed when
+        # the reply resolves.
+        ctx = None
+        tp = special.get(b"traceparent")
+        if tp is not None or self._tracer.sample_rate > 0.0:
+            t_accept = _time.monotonic()
+            ctx = self._tracer.start(tp, t_accept=t_accept)
+            if ctx is not None:
+                # The head was parsed just before routing; accept and
+                # parse collapse onto the route entry point (same
+                # convention as the threaded frontend).
+                ctx.event("accept", t_accept, t_accept, track="frontend")
+                ctx.event("parse", t_accept, t_accept, track="frontend")
         # Threaded parity: GET bodies are consumed for framing but not
         # evaluated (do_GET calls _handle_filter(b"")).
         eval_body = body if method != b"GET" else b""
@@ -798,7 +823,9 @@ class AsyncIngestFrontend:
                 t = special.get(b"x-waf-tenant")
                 tenant = t.decode("latin-1", "replace") if t else None
             req = _materialize(method, target_s, version, pairs, eval_body, remote_b)
-            return self._spawn(self._eval_pool, sc.filter_reply, req, tenant, deadline_s)
+            return self._spawn(
+                self._eval_pool, self._python_filter, req, tenant, deadline_s, ctx
+            )
         # -- hot path: slice the wire bytes straight into the native
         # batch-blob record (native.serialize_requests wire format; zero
         # HttpRequest materialization).
@@ -822,6 +849,12 @@ class AsyncIngestFrontend:
         buf += remote_b
         fut = self._loop.create_future()
         self._win_futs.append(fut)
+        if ctx is not None:
+            if self._win_traces is None:
+                self._win_traces = [None] * (len(self._win_futs) - 1)
+            self._win_traces.append(ctx)
+        elif self._win_traces is not None:
+            self._win_traces.append(None)
         self.parse_s += _time.perf_counter() - t0
         if len(self._win_futs) >= sc.config.max_batch_size:
             self._flush_window()
@@ -830,7 +863,7 @@ class AsyncIngestFrontend:
             self._win_timer = self._loop.call_later(delay, self._flush_window)
         return fut
 
-    def _route_api(self, method, path, special, body):
+    def _route_api(self, method, path, special, body, query=""):
         sc = self.sidecar
         if method == b"GET":
             if path == API_PREFIX + "healthz":
@@ -846,6 +879,8 @@ class AsyncIngestFrontend:
                     sc.metrics_reply,
                     auth.decode("latin-1", "replace") if auth else None,
                 )
+            if path == API_PREFIX + "trace":
+                return self._spawn(self._ctl_pool, sc.trace_reply, query)
         else:
             if path == API_PREFIX + "evaluate":
                 t = special.get(b"x-waf-tenant")
@@ -862,6 +897,14 @@ class AsyncIngestFrontend:
                 return self._spawn(
                     self._ctl_pool, sc.quarantine_flush_reply, body
                 )
+            if path == API_PREFIX + "profile":
+                auth = special.get(b"authorization")
+                return self._spawn(
+                    self._ctl_pool,
+                    sc.profile_reply,
+                    auth.decode("latin-1", "replace") if auth else None,
+                    body,
+                )
         return self._done(
             (
                 404,
@@ -869,6 +912,47 @@ class AsyncIngestFrontend:
                 {"Content-Type": "application/json"},
             )
         )
+
+    # -- flight-recorder plumbing --------------------------------------------
+
+    def _python_filter(self, req, tenant, deadline_s, ctx):
+        """Python-path filter evaluation (evaluation pool thread) with
+        the trace sealed onto the reply — mirrors the threaded
+        ``_handle_filter`` exactly."""
+        reply = self.sidecar.filter_reply(
+            req, tenant=tenant, deadline_s=deadline_s, span=ctx
+        )
+        return self._finish_trace(reply, ctx)
+
+    def _finish_trace(self, reply, ctx):
+        """Echo the response traceparent, stamp the reply span, and
+        commit the flight record. Identity for untraced requests."""
+        if ctx is None:
+            return reply
+        status, payload, headers = reply
+        headers = {**(headers or {}), "traceparent": ctx.response_traceparent()}
+        t_reply = _time.monotonic()
+        ctx.event("reply", t_reply, t_reply, track="frontend")
+        self.sidecar.tracer.commit(ctx)
+        return status, payload, headers
+
+    def _answer_all_traced(
+        self, futs, spans, builder, path=None, name=None
+    ) -> None:
+        """``_answer_all`` for windows that may carry flight-recorder
+        contexts: each traced reply gets its degraded-branch tag, the
+        response traceparent, and a committed record."""
+        if not spans:
+            self._answer_all(futs, builder)
+            return
+        sc = self.sidecar
+        for i, f in enumerate(futs):
+            if f.done():
+                continue
+            ctx = spans[i] if i < len(spans) else None
+            if ctx is not None and path is not None:
+                sc._span_degraded(ctx, path, name)
+            f.set_result(self._finish_trace(builder(), ctx))
 
     def _stats_reply(self):
         return (
@@ -928,12 +1012,14 @@ class AsyncIngestFrontend:
         if not futs:
             return
         blob = bytes(self._win_buf)
+        spans = self._win_traces
         self._win_futs = []
         self._win_buf = bytearray()
+        self._win_traces = None
         self.windows_total += 1
         self.window_requests_total += len(futs)
         try:
-            self._dispatch_window(blob, futs)
+            self._dispatch_window(blob, futs, spans)
         except Exception as err:
             # Dispatch containment: a routing bug answers this window
             # 500 instead of leaving futures (and connections) hanging.
@@ -943,55 +1029,65 @@ class AsyncIngestFrontend:
                 if not f.done():
                     f.set_result(reply)
 
-    def _dispatch_window(self, blob: bytes, futs: list) -> None:
+    def _dispatch_window(self, blob: bytes, futs: list, spans=None) -> None:
         """Route one assembled window. Runs on the loop thread — every
         step here is a cheap probe; blocking work goes to the batcher or
         the evaluation pool."""
         sc = self.sidecar
         engine = sc.tenants.engine_for(None)
         if engine is None:
-            self._answer_all(futs, sc.unavailable_reply)
+            self._answer_all_traced(
+                futs, spans, sc.unavailable_reply, "unavailable", "unavailable"
+            )
             return
         try:
             route = sc.degraded.route(engine)
         except BreakerOpen:
-            self._answer_all(futs, sc.breaker_filter_reply)
+            self._answer_all_traced(
+                futs, spans, sc.breaker_filter_reply, "breaker", "breaker_open"
+            )
             return
         if route == "fallback":
             self._inflight_windows += 1
-            self._submit_eval(self._fallback_window, engine, blob, futs)
+            self._submit_eval(self._fallback_window, engine, blob, futs, spans)
             return
         try:
             sc._admit_device(len(futs))
         except Overloaded as err:
             reply = sc.overloaded_reply(err, as_json=False)
-            self._answer_all(futs, lambda: reply)
+            self._answer_all_traced(futs, spans, lambda: reply, "shed", "shed")
             return
         self._inflight_windows += 1
-        wfut = sc.batcher.submit_window(blob, len(futs))
+        wfut = sc.batcher.submit_window(blob, len(futs), spans=spans)
         # Same budget ladder as the threaded bulk path: cold engines get
         # the compile budget; warmed ones the strict timeout plus a
         # bounded recompile grace (fresh-shape tier buckets mid-stream).
         timeout = sc._timeout_for([engine])
         if timeout <= sc.config.request_timeout_s:
             timeout += max(0.0, sc.config.recompile_grace_s)
-        handle = self._loop.call_later(timeout, self._window_timeout, wfut, futs)
+        handle = self._loop.call_later(
+            timeout, self._window_timeout, wfut, futs, spans
+        )
         wfut.add_done_callback(
-            lambda f: self._call_soon(self._window_done, f, futs, blob, engine, handle)
+            lambda f: self._call_soon(
+                self._window_done, f, futs, blob, engine, handle, spans
+            )
         )
 
-    def _window_timeout(self, wfut, futs) -> None:
+    def _window_timeout(self, wfut, futs, spans=None) -> None:
         # Threaded-path legacy-timeout contract: the failurePolicy
         # answers. Cancel so the batcher skips the window if still queued.
         wfut.cancel()
-        self._answer_all(futs, self.sidecar.unavailable_reply)
+        self._answer_all_traced(
+            futs, spans, self.sidecar.unavailable_reply, "error", "window_timeout"
+        )
 
-    def _window_done(self, wfut, futs, blob, engine, handle) -> None:
+    def _window_done(self, wfut, futs, blob, engine, handle, spans=None) -> None:
         self._inflight_windows -= 1
         handle.cancel()
         sc = self.sidecar
         try:
-            self._window_done_inner(wfut, futs, blob, engine)
+            self._window_done_inner(wfut, futs, blob, engine, spans)
         except Exception as err:
             log.error("ingest window completion failed", err)
             reply = (500, b"internal error\n", {"Content-Type": "text/plain"})
@@ -1000,7 +1096,7 @@ class AsyncIngestFrontend:
                     f.set_result(reply)
             sc.governor.count("conn_errors_total")
 
-    def _window_done_inner(self, wfut, futs, blob, engine) -> None:
+    def _window_done_inner(self, wfut, futs, blob, engine, spans=None) -> None:
         sc = self.sidecar
         if wfut.cancelled():
             self._answer_all(futs, sc.unavailable_reply)
@@ -1013,31 +1109,45 @@ class AsyncIngestFrontend:
             # The audit half (blob materialization + file IO) stays off
             # the loop thread.
             sc.count_window(verdicts)
-            for f, v in zip(futs, verdicts):
-                if not f.done():
-                    f.set_result(sc.verdict_filter_reply(v))
+            if spans:
+                for i, (f, v) in enumerate(zip(futs, verdicts)):
+                    if not f.done():
+                        ctx = spans[i] if i < len(spans) else None
+                        f.set_result(
+                            self._finish_trace(sc.verdict_filter_reply(v), ctx)
+                        )
+            else:
+                for f, v in zip(futs, verdicts):
+                    if not f.done():
+                        f.set_result(sc.verdict_filter_reply(v))
             self._submit_eval(sc.record_window, engine, blob, verdicts, True)
             return
         if isinstance(err, EngineUnavailable):
-            self._answer_all(futs, sc.unavailable_reply)
+            self._answer_all_traced(
+                futs, spans, sc.unavailable_reply, "unavailable", "unavailable"
+            )
             return
         if isinstance(err, BreakerOpen):
-            self._answer_all(futs, sc.breaker_filter_reply)
+            self._answer_all_traced(
+                futs, spans, sc.breaker_filter_reply, "breaker", "breaker_open"
+            )
             return
         if isinstance(err, Overloaded):
             reply = sc.overloaded_reply(err, as_json=False)
-            self._answer_all(futs, lambda: reply)
+            self._answer_all_traced(futs, spans, lambda: reply, "shed", "shed")
             return
         # Device failure: same rescue as the threaded path — re-answer
         # from the host fallback when enabled, else the failurePolicy.
         log.error("ingest window device path failed", err)
         if sc.degraded.fallback_enabled:
             self._inflight_windows += 1
-            self._submit_eval(self._fallback_window, engine, blob, futs)
+            self._submit_eval(self._fallback_window, engine, blob, futs, spans)
             return
-        self._answer_all(futs, sc.unavailable_reply)
+        self._answer_all_traced(
+            futs, spans, sc.unavailable_reply, "error", "window_error"
+        )
 
-    def _fallback_window(self, engine, blob: bytes, futs: list) -> None:
+    def _fallback_window(self, engine, blob: bytes, futs: list, spans=None) -> None:
         """Host-fallback evaluation of a whole window (evaluation pool
         thread): materialize the blob, evaluate on the scalar path, and
         answer with the identical per-request accounting the threaded
@@ -1047,22 +1157,38 @@ class AsyncIngestFrontend:
             from ..native import blob_requests
 
             reqs = blob_requests(blob, len(futs))
+            t0 = _time.monotonic()
             verdicts = sc._fallback_eval(engine, reqs)
+            t1 = _time.monotonic()
+            for ctx in spans or ():
+                if ctx is not None:
+                    ctx.annotate_path("fallback")
+                    ctx.event("fallback_eval", t0, t1, track="degraded")
             replies = []
             for r, v in zip(reqs, verdicts):
                 sc.record_verdict(r, v)
                 replies.append(sc.verdict_filter_reply(v))
         except Overloaded as oerr:
+            for ctx in spans or ():
+                sc._span_degraded(ctx, "shed", "shed")
             replies = [sc.overloaded_reply(oerr, as_json=False)] * len(futs)
         except Exception as err:
             log.error("ingest window fallback failed", err)
+            for ctx in spans or ():
+                sc._span_degraded(ctx, "error", "fallback_error")
             replies = [sc.unavailable_reply() for _ in futs]
 
         def finish():
             self._inflight_windows -= 1
-            for f, r in zip(futs, replies):
-                if not f.done():
-                    f.set_result(r)
+            if spans:
+                for i, (f, r) in enumerate(zip(futs, replies)):
+                    if not f.done():
+                        ctx = spans[i] if i < len(spans) else None
+                        f.set_result(self._finish_trace(r, ctx))
+            else:
+                for f, r in zip(futs, replies):
+                    if not f.done():
+                        f.set_result(r)
 
         self._call_soon(finish)
 

@@ -133,8 +133,13 @@ class QuarantineRegistry:
             self._entries[fp] = time.monotonic() + self.ttl_s
             self.isolated_total += 1
 
-    def match(self, req: HttpRequest) -> bool:
-        """True when the request is quarantined (counts a hit)."""
+    def match(self, req: HttpRequest, span=None) -> bool:
+        """True when the request is quarantined (counts a hit).
+
+        ``span`` is an optional flight-recorder context
+        (observability/tracing.py); a hit stamps the matched fingerprint
+        onto it, so an exported trace identifies WHICH quarantine entry
+        diverted the request off the device path."""
         with self._lock:
             if not self._entries:
                 return False
@@ -147,7 +152,16 @@ class QuarantineRegistry:
                 del self._entries[fp]
                 return False
             self.hits_total += 1
-            return True
+        if span is not None:
+            now = time.monotonic()
+            span.event(
+                "quarantine_match",
+                now,
+                now,
+                track="degraded",
+                args={"fingerprint": fp[:16]},
+            )
+        return True
 
     def flush(self) -> int:
         """Drop every entry; returns how many were held."""

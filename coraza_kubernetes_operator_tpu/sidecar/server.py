@@ -41,7 +41,7 @@ import sys
 
 from ..engine.request import HttpRequest
 from ..engine.waf import Verdict, WafEngine
-from ..observability import AuditLogger, MetricsRegistry
+from ..observability import AuditLogger, MetricsRegistry, TraceRecorder
 from ..observability.audit import AuditRecord
 from ..utils import get_logger
 from .batcher import (
@@ -81,6 +81,51 @@ def _tier_compile_stats() -> dict:
     from ..engine.tier_compile import TIER_COMPILER
 
     return TIER_COMPILER.stats()
+
+
+def _build_info_labels() -> dict:
+    """Label set for the cko_build_info gauge. The platform label comes
+    from JAX_PLATFORMS (not jax.devices()) so rendering metrics never
+    forces a backend initialization."""
+    from .. import __version__
+
+    try:
+        import jax
+
+        jax_version = getattr(jax, "__version__", "none")
+    except Exception:
+        jax_version = "none"
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "none")
+    except Exception:
+        jaxlib_version = "none"
+    platform = os.environ.get("JAX_PLATFORMS", "") or "default"
+    return {
+        "version": __version__,
+        "jax": jax_version,
+        "jaxlib": jaxlib_version,
+        "platform": platform,
+    }
+
+
+def _process_rss_bytes() -> float:
+    """Resident set size via /proc (no psutil dependency); 0 where
+    procfs is unavailable."""
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+def _process_open_fds() -> float:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return 0.0
 
 
 API_PREFIX = "/waf/v1/"
@@ -229,6 +274,20 @@ class SidecarConfig:
     shadow_latency_ratio: float | None = None
     shadow_idle_check_s: float | None = None
     rollout_ring_depth: int | None = None
+    # -- pipeline flight recorder (docs/OBSERVABILITY.md) --------------------
+    # Probability a request WITHOUT a traceparent header is traced; a
+    # request carrying the header is always recorded when the rate is
+    # > 0 (and always gets its response traceparent echoed, even at 0).
+    # None reads CKO_TRACE_SAMPLE_RATE (default 0.0 = recorder off: no
+    # hot-path cost beyond one attribute read).
+    trace_sample_rate: float | None = None
+    # Max completed traces retained in the flight-recorder ring. None
+    # reads CKO_TRACE_RING (default 512).
+    trace_ring: int | None = None
+    # Audit-log size cap: keep-1 rotation for path-backed audit logs
+    # once the live file would exceed this many bytes. None reads
+    # CKO_AUDIT_MAX_BYTES (default 0 = unbounded).
+    audit_max_bytes: int | None = None
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -273,6 +332,8 @@ _CONTROL_PATHS = {
     API_PREFIX + "metrics",
     API_PREFIX + "rollback",
     API_PREFIX + "quarantine/flush",
+    API_PREFIX + "trace",
+    API_PREFIX + "profile",
 }
 
 
@@ -409,6 +470,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(
                 *self.sidecar.metrics_reply(self.headers.get("Authorization"))
             )
+        elif path == API_PREFIX + "trace":
+            query = self.path.split("?", 1)[1] if "?" in self.path else ""
+            self._reply(*self.sidecar.trace_reply(query))
         elif path.startswith(API_PREFIX):
             self._reply_json(404, {"error": "not found"})
         else:
@@ -459,6 +523,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_rollback(body)
             elif path == API_PREFIX + "quarantine/flush":
                 self._reply(*self.sidecar.quarantine_flush_reply(body))
+            elif path == API_PREFIX + "profile":
+                self._reply(
+                    *self.sidecar.profile_reply(
+                        self.headers.get("Authorization"), body
+                    )
+                )
             elif path.startswith(API_PREFIX):
                 self._reply_json(404, {"error": "not found"})
             else:
@@ -497,6 +567,15 @@ class _Handler(BaseHTTPRequestHandler):
         return _time.monotonic() + ms / 1e3
 
     def _handle_filter(self, body: bytes) -> None:
+        # Flight recorder (docs/OBSERVABILITY.md): parse/mint the W3C
+        # trace context BEFORE evaluation so the span rides the batcher
+        # item; the response traceparent is echoed even when sampling is
+        # off (non-recording context — byte-identical to the async
+        # frontend's echo for the same inbound header).
+        t_accept = _time.monotonic()
+        ctx = self.sidecar.tracer.start(
+            self.headers.get("traceparent"), t_accept=t_accept
+        )
         req = HttpRequest(
             method=self.command,
             uri=self.path,
@@ -508,9 +587,20 @@ class _Handler(BaseHTTPRequestHandler):
         tenant = None
         if self.sidecar.config.trust_tenant_header:
             tenant = self.headers.get(TENANT_HEADER) or None
-        self._reply(
-            *self.sidecar.filter_reply(req, tenant=tenant, deadline_s=self._deadline_s())
+        if ctx is not None:
+            # http.server already parsed the head before do_* ran, so
+            # accept and parse collapse onto the handler entry point.
+            ctx.event("accept", t_accept, t_accept, track="frontend")
+            ctx.event("parse", t_accept, _time.monotonic(), track="frontend")
+        status, payload, headers = self.sidecar.filter_reply(
+            req, tenant=tenant, deadline_s=self._deadline_s(), span=ctx
         )
+        if ctx is not None:
+            headers = {**(headers or {}), "traceparent": ctx.response_traceparent()}
+            t_reply = _time.monotonic()
+            ctx.event("reply", t_reply, t_reply, track="frontend")
+            self.sidecar.tracer.commit(ctx)
+        self._reply(status, payload, headers)
 
     def _handle_bulk(self, body: bytes) -> None:
         self._reply(
@@ -922,6 +1012,38 @@ class TpuEngineSidecar:
         self._fb_lock = threading.Lock()
         self._fallback_inflight = 0
         self.batcher.stats.on_batch = self._on_batch
+        # -- pipeline flight recorder (docs/OBSERVABILITY.md) ---------------
+        # Per-request end-to-end tracing: config field -> CKO_TRACE_* env
+        # -> defaults (sampling off). Resolved values are normalized back
+        # onto config so stats() and operators see one number.
+        if config.trace_sample_rate is None:
+            try:
+                config.trace_sample_rate = float(
+                    os.environ.get("CKO_TRACE_SAMPLE_RATE", "") or 0.0
+                )
+            except ValueError:
+                config.trace_sample_rate = 0.0
+        if config.trace_ring is None:
+            try:
+                config.trace_ring = int(os.environ.get("CKO_TRACE_RING", "") or 512)
+            except ValueError:
+                config.trace_ring = 512
+        self.tracer = TraceRecorder(
+            capacity=config.trace_ring, sample_rate=config.trace_sample_rate
+        )
+        self.metrics.gauge(
+            "cko_traces_recorded_total",
+            "Flight-recorder traces committed to the trace ring",
+        ).set_function(lambda: float(self.tracer.writes))
+        self.metrics.gauge(
+            "cko_traces_dropped_total",
+            "Flight-recorder traces evicted from the full trace ring",
+        ).set_function(lambda: float(self.tracer.dropped))
+        # On-demand device profiling (POST /waf/v1/profile): wraps
+        # jax.profiler start/stop; requires the metrics bearer token.
+        self._profile_lock = threading.Lock()
+        self._profiling = False
+        self._profile_dir = ""
         self.audit: AuditLogger | None = None
         if config.audit_log == "-":
             self.audit = AuditLogger(
@@ -929,8 +1051,30 @@ class TpuEngineSidecar:
             )
         elif config.audit_log:
             self.audit = AuditLogger(
-                path=config.audit_log, relevant_only=config.audit_relevant_only
+                path=config.audit_log,
+                relevant_only=config.audit_relevant_only,
+                max_bytes=config.audit_max_bytes,
             )
+        self.metrics.gauge(
+            "cko_audit_rotations_total",
+            "Audit-log size rotations (keep-1 rollover)",
+        ).set_function(
+            lambda: float(self.audit.rotations if self.audit is not None else 0)
+        )
+        # -- build / process identity (docs/OBSERVABILITY.md) ---------------
+        self.metrics.gauge(
+            "cko_build_info",
+            "Build/runtime identity; the value is always 1",
+            ("version", "jax", "jaxlib", "platform"),
+        ).set(1.0, **_build_info_labels())
+        self.metrics.gauge(
+            "cko_process_resident_memory_bytes",
+            "Resident set size of the sidecar process",
+        ).set_function(_process_rss_bytes)
+        self.metrics.gauge(
+            "cko_process_open_fds",
+            "Open file descriptors held by the sidecar process",
+        ).set_function(_process_open_fds)
         # -- ingest frontend (docs/SERVING.md) ------------------------------
         self._httpd: _Server | None = None
         self._frontend = None
@@ -1005,14 +1149,21 @@ class TpuEngineSidecar:
         fe = getattr(self, "_frontend", None)
         return 0 if fe is None else getattr(fe, field, 0)
 
-    def _on_batch(self, size: int, latency_s: float) -> None:
+    def _on_batch(
+        self, size: int, latency_s: float, trace_id: str | None = None
+    ) -> None:
+        # trace_id (when a traced request rode the batch) becomes an
+        # OpenMetrics exemplar on the latency histogram — the bridge
+        # from an aggregate tail bucket to one concrete flight record.
         self._m_batches.inc()
         self._m_batch_size.observe(size)
-        self._m_step.observe(latency_s)
+        self._m_step.observe(latency_s, exemplar=trace_id)
 
-    def _on_stage(self, host_s: float, device_s: float) -> None:
-        self._m_host_stage.observe(host_s)
-        self._m_device_stage.observe(device_s)
+    def _on_stage(
+        self, host_s: float, device_s: float, trace_id: str | None = None
+    ) -> None:
+        self._m_host_stage.observe(host_s, exemplar=trace_id)
+        self._m_device_stage.observe(device_s, exemplar=trace_id)
 
     def record_verdict(
         self, request: HttpRequest, verdict: Verdict, tenant: str | None = None
@@ -1283,6 +1434,96 @@ class TpuEngineSidecar:
             200, {"flushed": flushed, "entries": len(self.quarantine)}
         )
 
+    def trace_reply(self, query: str = "") -> tuple[int, bytes, dict]:
+        """GET /waf/v1/trace — export the flight-recorder ring as Chrome
+        trace-event JSON (load the payload in Perfetto or
+        chrome://tracing). ``?trace_id=<32hex>`` narrows the export to
+        one trace; 404 when that id is not (or no longer) in the ring."""
+        trace_id = None
+        for part in (query or "").split("&"):
+            if part.startswith("trace_id="):
+                trace_id = part.split("=", 1)[1].strip().lower() or None
+        if trace_id is not None and not self.tracer.snapshot(trace_id):
+            return _json_reply(404, {"error": f"trace {trace_id} not recorded"})
+        return (
+            200,
+            self.tracer.chrome_trace_json(trace_id),
+            {"Content-Type": "application/json"},
+        )
+
+    def profile_reply(
+        self, authorization: str | None, body: bytes
+    ) -> tuple[int, bytes, dict]:
+        """POST /waf/v1/profile — on-demand device profiling wrapping
+        ``jax.profiler``. Body: ``{"action": "start"|"stop"}``;
+        ``{"dir": ...}`` optionally overrides the start dump directory
+        (default CKO_PROFILE_DIR or /tmp/cko-profile).
+
+        Auth: the profiler serializes device execution and writes dumps
+        to disk, so the endpoint is bearer-guarded with the SAME token
+        as /waf/v1/metrics — and DENIED outright (403) when no token is
+        configured: an unauthenticated listener must not expose a
+        device-stalling control."""
+        import hmac
+
+        token = self.config.metrics_auth_token
+        if not token:
+            return _json_reply(
+                403,
+                {"error": "profiling disabled: no metrics auth token configured"},
+            )
+        presented = authorization or ""
+        if not hmac.compare_digest(
+            presented.encode(), f"Bearer {token}".encode()
+        ):
+            return _json_reply(401, {"error": "unauthorized"})
+        try:
+            payload = json.loads(body.decode("utf-8")) if body else {}
+            action = (payload or {}).get("action")
+        except (ValueError, AttributeError):
+            return _json_reply(400, {"error": "invalid profile payload"})
+        if action not in ("start", "stop"):
+            return _json_reply(400, {"error": 'action must be "start" or "stop"'})
+        with self._profile_lock:
+            if action == "start":
+                if self._profiling:
+                    return _json_reply(409, {"error": "profiler already running"})
+                profile_dir = (
+                    (payload or {}).get("dir")
+                    or os.environ.get("CKO_PROFILE_DIR", "")
+                    or "/tmp/cko-profile"
+                )
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(profile_dir)
+                except Exception as err:
+                    return _json_reply(
+                        500,
+                        {
+                            "error": "profiler start failed:"
+                            f" {type(err).__name__}: {err}"
+                        },
+                    )
+                self._profiling = True
+                self._profile_dir = profile_dir
+                log.info("device profiling started", dir=profile_dir)
+                return _json_reply(200, {"profiling": True, "dir": profile_dir})
+            if not self._profiling:
+                return _json_reply(409, {"error": "profiler not running"})
+            self._profiling = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as err:
+                return _json_reply(
+                    500,
+                    {"error": f"profiler stop failed: {type(err).__name__}: {err}"},
+                )
+            log.info("device profiling stopped", dir=self._profile_dir)
+            return _json_reply(200, {"profiling": False, "dir": self._profile_dir})
+
     def overloaded_reply(
         self, err: Overloaded, as_json: bool
     ) -> tuple[int, bytes, dict]:
@@ -1360,22 +1601,42 @@ class TpuEngineSidecar:
         req: HttpRequest,
         tenant: str | None = None,
         deadline_s: float | None = None,
+        span=None,
     ) -> tuple[int, bytes, dict]:
         """Filter mode, end to end: evaluate the inbound request and map
-        the verdict (or degraded-mode exception) to the wire reply."""
+        the verdict (or degraded-mode exception) to the wire reply.
+        ``span`` is an optional flight-recorder context; degraded exits
+        tag it so an exported trace names the branch taken."""
         try:
-            verdict = self.evaluate(req, tenant=tenant, deadline_s=deadline_s)
+            verdict = self.evaluate(req, tenant=tenant, deadline_s=deadline_s, span=span)
         except Overloaded as err:
+            self._span_degraded(span, "shed", "shed")
             return self.overloaded_reply(err, as_json=False)
         except BreakerOpen:
+            self._span_degraded(span, "breaker", "breaker_open")
             return self.breaker_filter_reply()
         except EngineUnavailable:
+            self._span_degraded(span, "unavailable", "unavailable")
             return self.unavailable_reply()
         except Exception as err:  # evaluation failure → failurePolicy
             log.error("filter evaluation failed", err)
+            self._span_degraded(span, "error", "eval_error")
             return self.unavailable_reply()
         self.record_verdict(req, verdict, tenant=tenant)
         return self.verdict_filter_reply(verdict)
+
+    @staticmethod
+    def _span_degraded(span, path: str, name: str) -> None:
+        """Stamp a degraded-branch point event onto a flight record
+        (no-op for untraced / non-recording requests; never raises)."""
+        if span is None:
+            return
+        try:
+            now = _time.monotonic()
+            span.annotate_path(path)
+            span.event(name, now, now, track="degraded")
+        except Exception:
+            pass
 
     def bulk_reply(
         self,
@@ -1474,7 +1735,9 @@ class TpuEngineSidecar:
                 retry_after_s=self.config.shed_retry_after_s,
             )
 
-    def _fallback_eval(self, engine, requests: list[HttpRequest]) -> list[Verdict]:
+    def _fallback_eval(
+        self, engine, requests: list[HttpRequest], span=None
+    ) -> list[Verdict]:
         """Host-fallback evaluation with its own concurrency admission
         (the fallback runs on handler threads)."""
         budget = self.config.fallback_inflight_budget
@@ -1487,7 +1750,7 @@ class TpuEngineSidecar:
                 )
             self._fallback_inflight += 1
         try:
-            return self.degraded.fallback_evaluate(engine, requests)
+            return self.degraded.fallback_evaluate(engine, requests, span=span)
         finally:
             with self._fb_lock:
                 self._fallback_inflight -= 1
@@ -1509,17 +1772,18 @@ class TpuEngineSidecar:
         request: HttpRequest,
         tenant: str | None = None,
         deadline_s: float | None = None,
+        span=None,
     ) -> Verdict:
         engine = self.tenants.engine_for(tenant)
         if engine is None:
             raise EngineUnavailable(f"no compiled ruleset loaded for {tenant!r}")
         if self.degraded.route(engine) == "fallback":
-            return self._fallback_eval(engine, [request])[0]
+            return self._fallback_eval(engine, [request], span=span)[0]
         self._admit_device()
         timeout = self._timeout_for([engine])
         if deadline_s is not None:
             timeout = max(0.001, min(timeout, deadline_s - _time.monotonic()))
-        fut = self.batcher.submit(request, tenant=tenant)
+        fut = self.batcher.submit(request, tenant=tenant, span=span)
         try:
             return fut.result(timeout=timeout)
         except EngineUnavailable:
@@ -1538,7 +1802,7 @@ class TpuEngineSidecar:
             # cancelled futures still in its queue).
             fut.cancel()
             log.error("device path failed; serving from host fallback", err)
-            return self._fallback_eval(engine, [request])[0]
+            return self._fallback_eval(engine, [request], span=span)[0]
 
     def evaluate_bulk_fast(self, body: bytes) -> list[dict] | None:
         """Native bulk evaluation for the default tenant. Returns the
@@ -1818,6 +2082,7 @@ class TpuEngineSidecar:
                 "bisect_dropped": self.bisector.jobs_dropped,
             },
             "request_timeout_s": self.config.request_timeout_s,
+            "tracing": self.tracer.stats(),
             "tenants": self.tenants.stats(),
             "reloads": self.tenants.total_reloads,
             "failed_reloads": self.tenants.total_failed_reloads,
@@ -1944,6 +2209,9 @@ class TpuEngineSidecar:
         self.tenants.stop()
         self._persist_state()
         if self.audit is not None:
+            # Explicit flush before close: every audit line for drained
+            # requests reaches the file before the process exits.
+            self.audit.flush()
             self.audit.close()
         drain_s = _time.monotonic() - t0
         self._m_drain.set(drain_s)

@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Metrics/docs drift lint (``make metrics.lint``).
+
+Every ``cko_*`` / ``waf_*`` metric registered anywhere in the package
+must appear in a metric table in the operator docs, and every metric
+documented there must still exist in code — stale docs send operators
+chasing series that will never appear, and undocumented metrics never
+make it onto a dashboard.
+
+Mechanics (pure stdlib, no imports of the package — the lint must run
+without jax):
+
+- **Code side**: regex scan of ``coraza_kubernetes_operator_tpu/`` for
+  ``.counter("name" ...)`` / ``.gauge("name" ...)`` /
+  ``.histogram("name" ...)`` registrations whose name matches
+  ``cko_*``/``waf_*``.
+- **Docs side**: markdown *table rows* (lines starting with ``|``) in
+  the observability docs, matching any ``cko_*``/``waf_*`` token.
+
+Exit 0 and a summary when the two sets match; exit 1 with the exact
+drift otherwise.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "coraza_kubernetes_operator_tpu"
+
+# The operator-facing docs that carry metric tables. OBSERVABILITY.md is
+# the consolidated catalog; the per-subsystem pages document their own
+# slices.
+DOC_FILES = (
+    "docs/OBSERVABILITY.md",
+    "docs/DEGRADED_MODE.md",
+    "docs/PIPELINE.md",
+    "docs/ROLLOUT.md",
+    "docs/RECOVERY.md",
+    "docs/SERVING.md",
+)
+
+_REGISTER_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"((?:cko|waf)_[a-z0-9_]+)"'
+)
+_DOC_TOKEN_RE = re.compile(r"\b((?:cko|waf)_[a-z0-9_]+)\b")
+
+# Suffixes the exposition format appends to histograms — doc tables name
+# the base metric, grep hits on _bucket/_sum/_count normalize to it.
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def registered_metrics() -> set[str]:
+    names: set[str] = set()
+    for path in sorted(PKG.rglob("*.py")):
+        names.update(_REGISTER_RE.findall(path.read_text(encoding="utf-8")))
+    return names
+
+
+def documented_metrics() -> dict[str, list[str]]:
+    """name -> doc files whose metric tables mention it."""
+    where: dict[str, list[str]] = {}
+    for rel in DOC_FILES:
+        path = REPO / rel
+        if not path.exists():
+            continue
+        for line in path.read_text(encoding="utf-8").splitlines():
+            if not line.lstrip().startswith("|"):
+                continue
+            for tok in _DOC_TOKEN_RE.findall(line):
+                for suf in _HISTO_SUFFIXES:
+                    if tok.endswith(suf):
+                        tok = tok[: -len(suf)]
+                        break
+                where.setdefault(tok, [])
+                if rel not in where[tok]:
+                    where[tok].append(rel)
+    return where
+
+
+def main() -> int:
+    code = registered_metrics()
+    docs = documented_metrics()
+    undocumented = sorted(code - set(docs))
+    dead = sorted(set(docs) - code)
+    if undocumented:
+        print("UNDOCUMENTED metrics (registered in code, absent from every"
+              " doc metric table):")
+        for name in undocumented:
+            print(f"  {name}")
+    if dead:
+        print("DEAD documented metrics (in a doc metric table, registered"
+              " nowhere):")
+        for name in dead:
+            print(f"  {name}  ({', '.join(docs[name])})")
+    if undocumented or dead:
+        print(f"\nmetrics lint FAILED: {len(undocumented)} undocumented,"
+              f" {len(dead)} dead")
+        return 1
+    print(f"metrics lint OK: {len(code)} metrics registered, all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Tracing overhead + span-chain smoke (ISSUE 14 CI satellite).
+
+Two gates over the flight recorder (docs/OBSERVABILITY.md), reusing the
+ingest smoke's socket driver:
+
+1. **Overhead**: the SAME pipelined request stream driven through the
+   async frontend with ``CKO_TRACE_SAMPLE_RATE`` 0.0 vs 1.0 must stay
+   within ``TRACE_SMOKE_DELTA`` (default 5%) throughput of each other —
+   sampling off is the default production posture and must be
+   noise-level; sampling on is one list append per stage and must stay
+   cheap enough to turn on during an incident.
+2. **Span chains**: one exported trace per serving path exercised —
+   promoted (complete ``accept → … → reply`` chain), fallback
+   (``fallback_eval`` on a cold engine), shed (``shed`` under a zeroed
+   queue budget) — each validating as Chrome trace-event JSON.
+
+Usage: trace_smoke.py [--requests 2000] [--conns 8] [--depth 32]
+[--delta 0.05] (env: TRACE_SMOKE_REQUESTS / _CONNS / _DEPTH / _DELTA).
+Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "hack"))
+sys.path.insert(0, str(REPO))
+
+from ingest_smoke import _drive, _request_bytes  # noqa: E402
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return resp.status, resp.read()
+
+
+def _trace_paths(port):
+    """path -> list of span-name lists, from the full exported ring."""
+    status, body = _get(port, "/waf/v1/trace")
+    assert status == 200, status
+    doc = json.loads(body)
+    by_trace: dict[str, dict] = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] != "X":
+            continue
+        rec = by_trace.setdefault(
+            e["args"]["trace_id"], {"path": e["args"]["path"], "names": []}
+        )
+        rec["names"].append(e["name"])
+    out: dict[str, list[list[str]]] = {}
+    for rec in by_trace.values():
+        out.setdefault(rec["path"], []).append(rec["names"])
+    return out
+
+
+def main() -> int:
+    n_requests = int(
+        os.environ.get("TRACE_SMOKE_REQUESTS", "")
+        or os.environ.get("INGEST_SMOKE_REQUESTS", "2000")
+    )
+    conns = int(os.environ.get("TRACE_SMOKE_CONNS", "8"))
+    depth = int(os.environ.get("TRACE_SMOKE_DEPTH", "32"))
+    delta_max = float(os.environ.get("TRACE_SMOKE_DELTA", "0.05"))
+    reps = int(os.environ.get("TRACE_SMOKE_REPS", "3"))
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--requests":
+            n_requests = int(args.pop(0))
+        elif a == "--conns":
+            conns = int(args.pop(0))
+        elif a == "--depth":
+            depth = int(args.pop(0))
+        elif a == "--delta":
+            delta_max = float(args.pop(0))
+
+    os.environ.setdefault("CKO_VALUE_CACHE_MB", "0")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.corpus import (
+        synthetic_crs,
+        synthetic_requests,
+    )
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.observability.tracing import (
+        PIPELINE_CHAIN,
+    )
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    configure_persistent_cache(os.environ.get("CKO_COMPILE_CACHE_DIR"))
+    eng = WafEngine(synthetic_crs(40, seed=3))
+    payloads = [
+        _request_bytes(r)
+        for r in synthetic_requests(n_requests, attack_ratio=0.2, seed=7)
+    ]
+    warm = payloads[: min(256, len(payloads))]
+
+    def sidecar(**kw):
+        engine_obj = kw.pop("engine_obj", None)
+        return TpuEngineSidecar(
+            SidecarConfig(
+                host="127.0.0.1",
+                port=0,
+                max_batch_size=128,
+                max_batch_delay_ms=2.0,
+                frontend="async",
+                **kw,
+            ),
+            engine=engine_obj or eng,
+        )
+
+    def wait_mode(sc, mode, timeout_s=600):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and sc.serving_mode() != mode:
+            time.sleep(0.02)
+        return sc.serving_mode() == mode
+
+    # -- gate 1: sampling 0.0 vs 1.0 throughput -----------------------------
+    # Full untimed pass first so tier compiles land before either timed
+    # run — the engine (and its executables) is shared by both configs.
+    sc = sidecar()
+    sc.start()
+    try:
+        assert wait_mode(sc, "promoted"), sc.serving_mode()
+        _drive(sc.port, payloads, conns, depth)
+    finally:
+        sc.stop()
+
+    walls = {}
+    for rate in (0.0, 1.0):
+        sc = sidecar(trace_sample_rate=rate)
+        sc.start()
+        try:
+            assert wait_mode(sc, "promoted"), sc.serving_mode()
+            _drive(sc.port, warm, conns, depth)  # untimed warm
+            best = min(
+                _drive(sc.port, payloads, conns, depth)[1] for _ in range(reps)
+            )
+            walls[rate] = best
+        finally:
+            sc.stop()
+    rps_off = n_requests / max(walls[0.0], 1e-9)
+    rps_on = n_requests / max(walls[1.0], 1e-9)
+    delta = (rps_off - rps_on) / max(rps_off, 1e-9)
+
+    # -- gate 2: one complete trace per serving path ------------------------
+    chains = {}
+
+    # promoted: warm engine, full chain
+    sc = sidecar(trace_sample_rate=1.0)
+    sc.start()
+    try:
+        assert wait_mode(sc, "promoted")
+        _drive(sc.port, warm[:32], 2, 8)
+        paths = _trace_paths(sc.port)
+        chains["promoted"] = next(
+            (
+                names
+                for names in paths.get("promoted", [])
+                if [n for n in names if n in PIPELINE_CHAIN]
+                == list(PIPELINE_CHAIN)
+            ),
+            None,
+        )
+    finally:
+        sc.stop()
+
+    # fallback: a cold engine compiles for seconds — requests sent before
+    # promotion ride the host fallback
+    cold = WafEngine(synthetic_crs(6, seed=11))
+    sc = sidecar(trace_sample_rate=1.0, engine_obj=cold)
+    sc.start()
+    try:
+        assert wait_mode(sc, "fallback", timeout_s=60)
+        _drive(sc.port, warm[:16], 2, 4)
+        paths = _trace_paths(sc.port)
+        chains["fallback"] = next(
+            (
+                names
+                for names in paths.get("fallback", [])
+                if "fallback_eval" in names
+                and "accept" in names
+                and "reply" in names
+            ),
+            None,
+        )
+        # Let the promotion probe's compile finish before teardown — an
+        # XLA compile in flight at interpreter exit aborts the process.
+        wait_mode(sc, "promoted", timeout_s=120)
+    finally:
+        sc.stop()
+
+    # shed: zero queue budget + a pipelined burst -> 429s with shed spans
+    sc = sidecar(trace_sample_rate=1.0, queue_budget=0)
+    sc.start()
+    try:
+        assert wait_mode(sc, "promoted")
+        for _ in range(10):
+            _drive(sc.port, warm[:128], 8, 32)
+            paths = _trace_paths(sc.port)
+            chains["shed"] = next(
+                (
+                    names
+                    for names in paths.get("shed", [])
+                    if "shed" in names and "accept" in names and "reply" in names
+                ),
+                None,
+            )
+            if chains["shed"]:
+                break
+    finally:
+        sc.stop()
+
+    verdict = {
+        "req_per_s_sampling_off": round(rps_off, 1),
+        "req_per_s_sampling_on": round(rps_on, 1),
+        "throughput_delta": round(delta, 4),
+        "delta_max": delta_max,
+        "requests": n_requests,
+        "reps": reps,
+        "chains": chains,
+        "cpus": os.cpu_count(),
+    }
+    ok = delta < delta_max and all(chains.get(p) for p in ("promoted", "fallback", "shed"))
+    verdict["smoke"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,7 +332,7 @@ def test_deadline_header_falls_back_when_device_misses_it(monkeypatch):
     sc.start()
     try:
         # Wedge the device path: futures never resolve.
-        sc.batcher.submit = lambda request, tenant=None: Future()
+        sc.batcher.submit = lambda request, tenant=None, span=None: Future()
         t0 = time.monotonic()
         status, _, _ = _http(
             sc.port,

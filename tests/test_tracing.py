@@ -1,0 +1,360 @@
+"""Pipeline flight-recorder tests (docs/OBSERVABILITY.md).
+
+Unit coverage for the W3C trace-context helpers, the bounded
+``TraceRecorder`` ring, histogram exemplars, and audit-log rotation —
+plus the end-to-end acceptance assertions: a request carrying
+``traceparent`` through EITHER frontend yields a byte-identical response
+header and a complete ``accept → … → reply`` span chain exported as
+Chrome trace-event JSON at ``GET /waf/v1/trace``, and with sampling off
+the ring is never written.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.engine import WafEngine
+from coraza_kubernetes_operator_tpu.observability import (
+    AuditLogger,
+    MetricsRegistry,
+    TraceRecorder,
+    derive_span_id,
+    format_traceparent,
+    parse_traceparent,
+)
+from coraza_kubernetes_operator_tpu.observability.audit import AuditRecord
+from coraza_kubernetes_operator_tpu.observability.tracing import (
+    PIPELINE_CHAIN,
+    TRACKS,
+)
+from coraza_kubernetes_operator_tpu.sidecar import SidecarConfig, TpuEngineSidecar
+
+RULES = """
+SecRuleEngine On
+SecRequestBodyAccess On
+SecDefaultAction "phase:2,log,deny,status:403"
+SecRule ARGS|REQUEST_URI "@contains evilmonkey" \\
+  "id:3001,phase:2,deny,status:403,t:none,msg:'Evil Monkey'"
+"""
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+TRACE_ID = "ab" * 16
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WafEngine(RULES)
+
+
+# -- traceparent helpers ------------------------------------------------------
+
+
+def test_parse_traceparent_valid():
+    assert parse_traceparent(TP) == (TRACE_ID, "cd" * 8, 1)
+    # bytes and mixed case are normalized
+    assert parse_traceparent(TP.upper().encode()) == (TRACE_ID, "cd" * 8, 1)
+    # extra future-version fields after flags are tolerated
+    assert parse_traceparent(TP + "-extra") == (TRACE_ID, "cd" * 8, 1)
+
+
+def test_parse_traceparent_rejects_malformed():
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-short-cdcdcdcdcdcdcdcd-01") is None
+    assert parse_traceparent("00-" + "zz" * 16 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("00-" + "00" * 16 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("00-" + "ab" * 16 + "-" + "00" * 8 + "-01") is None
+
+
+def test_format_round_trip():
+    assert parse_traceparent(format_traceparent(TRACE_ID, "cd" * 8, 1)) == (
+        TRACE_ID,
+        "cd" * 8,
+        1,
+    )
+
+
+def test_derive_span_id_deterministic():
+    a = derive_span_id(TRACE_ID, "cd" * 8)
+    assert a == derive_span_id(TRACE_ID, "cd" * 8)
+    assert len(a) == 16
+    int(a, 16)
+    assert a != derive_span_id(TRACE_ID, "ef" * 8)
+    assert a != "cd" * 8
+
+
+# -- recorder sampling + ring -------------------------------------------------
+
+
+def test_recorder_rate_zero_no_header_is_free():
+    rec = TraceRecorder(capacity=8, sample_rate=0.0)
+    assert rec.start(None) is None
+    assert rec.stats()["writes"] == 0
+
+
+def test_recorder_rate_zero_header_echoes_without_recording():
+    rec = TraceRecorder(capacity=8, sample_rate=0.0)
+    ctx = rec.start(TP)
+    assert ctx is not None and not ctx.recording
+    assert ctx.response_traceparent() == format_traceparent(
+        TRACE_ID, derive_span_id(TRACE_ID, "cd" * 8), 1
+    )
+    ctx.event("accept", time.monotonic())
+    assert ctx.span_names() == []
+    rec.commit(ctx)
+    assert rec.stats() == {
+        "sample_rate": 0.0,
+        "capacity": 8,
+        "size": 0,
+        "writes": 0,
+        "dropped": 0,
+    }
+
+
+def test_recorder_ring_bound_and_commit_idempotent():
+    rec = TraceRecorder(capacity=4, sample_rate=1.0)
+    last = None
+    for _ in range(10):
+        ctx = rec.start(None)
+        assert ctx is not None and ctx.recording
+        t = time.monotonic()
+        ctx.event("accept", t, t)
+        rec.commit(ctx)
+        last = ctx
+    rec.commit(last)  # idempotent — already sealed
+    stats = rec.stats()
+    assert stats["size"] == 4
+    assert stats["writes"] == 10
+    assert stats["dropped"] == 6
+    # per-trace lookup of an evicted record is empty
+    assert len(rec.snapshot()) == 4
+
+
+def test_chrome_trace_export_format():
+    rec = TraceRecorder(capacity=8, sample_rate=1.0)
+    ctx = rec.start(TP)
+    t0 = time.monotonic()
+    ctx.event("accept", t0, t0)
+    ctx.event("queue", t0, t0 + 0.001, track="pipeline")
+    ctx.annotate_path("fallback")
+    rec.commit(ctx)
+
+    doc = json.loads(rec.chrome_trace_json(TRACE_ID))
+    assert isinstance(doc["traceEvents"], list)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    assert {e["args"]["name"] for e in meta if e["name"] == "thread_name"} == set(
+        TRACKS
+    )
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["accept", "queue"]
+    for e in spans:
+        assert e["ts"] >= 0.0 and e["dur"] >= 0.0
+        assert e["args"]["trace_id"] == TRACE_ID
+        assert e["args"]["path"] == "fallback"
+    assert doc["otherData"]["traces"] == 1
+    # unknown trace id → empty selection, still valid JSON
+    assert json.loads(rec.chrome_trace_json("ef" * 16))["otherData"]["traces"] == 0
+
+
+# -- exemplars ----------------------------------------------------------------
+
+
+def test_histogram_exemplar_exposition_format():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "test", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar=TRACE_ID)
+    h.observe(0.5)  # no exemplar on this bucket
+    text = reg.render()
+    lines = [ln for ln in text.splitlines() if ln.startswith("t_seconds_bucket")]
+    assert any(
+        'le="0.1"' in ln and f'# {{trace_id="{TRACE_ID}"}} 0.05 ' in ln
+        for ln in lines
+    )
+    # exemplar rides only the bucket it landed in
+    assert all(
+        "trace_id" not in ln for ln in lines if 'le="1.0"' in ln or 'le="+Inf"' in ln
+    )
+
+
+# -- audit rotation -----------------------------------------------------------
+
+
+def test_audit_rotation_and_flush(tmp_path):
+    path = tmp_path / "audit.log"
+    logger = AuditLogger(path=str(path), relevant_only=False, max_bytes=512)
+    for i in range(24):
+        logger.log(AuditRecord(request_line=f"GET /r{i} HTTP/1.1", status=200))
+    logger.flush()
+    assert logger.rotations >= 1
+    rolled = tmp_path / "audit.log.1"
+    assert rolled.exists()
+    # both generations hold whole JSON lines
+    for p in (path, rolled):
+        for ln in p.read_text().splitlines():
+            json.loads(ln)
+    assert path.stat().st_size <= 512 + 256  # one record of slack past the cap
+    logger.close()
+
+
+def test_audit_unbounded_by_default(tmp_path):
+    path = tmp_path / "audit.log"
+    logger = AuditLogger(path=str(path), relevant_only=False)
+    for i in range(24):
+        logger.log(AuditRecord(request_line=f"GET /r{i} HTTP/1.1", status=200))
+    logger.close()
+    assert logger.rotations == 0
+    assert not (tmp_path / "audit.log.1").exists()
+
+
+# -- end-to-end: both frontends -----------------------------------------------
+
+
+def _sidecar(engine, frontend, **kw):
+    return TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1",
+            port=0,
+            max_batch_delay_ms=0.5,
+            frontend=frontend,
+            **kw,
+        ),
+        engine=engine,
+    )
+
+
+def _wait_promoted(sc, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while sc.serving_mode() != "promoted" and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sc.serving_mode() == "promoted"
+
+
+def _http(port, path, headers=None, method="GET", body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method, data=body,
+        headers=headers or {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=30)
+        return resp.status, {k.lower(): v for k, v in resp.headers.items()}, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, {k.lower(): v for k, v in e.headers.items()}, e.read()
+
+
+def _traced_chain(port, trace_id):
+    status, _, body = _http(port, f"/waf/v1/trace?trace_id={trace_id}")
+    assert status == 200
+    doc = json.loads(body)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert all(e["args"]["trace_id"] == trace_id for e in spans)
+    return doc, [e["name"] for e in spans]
+
+
+@pytest.mark.parametrize("frontend", ["async", "threaded"])
+def test_full_span_chain_exported(engine, frontend):
+    sc = _sidecar(engine, frontend, trace_sample_rate=1.0)
+    sc.start()
+    try:
+        _wait_promoted(sc)
+        status, headers, _ = _http(
+            sc.port, "/?q=clean", headers={"traceparent": TP}
+        )
+        assert status == 200
+        assert headers["traceparent"] == format_traceparent(
+            TRACE_ID, derive_span_id(TRACE_ID, "cd" * 8), 1
+        )
+        doc, names = _traced_chain(sc.port, TRACE_ID)
+        # the complete promoted-path chain, in pipeline order
+        assert [n for n in names if n in PIPELINE_CHAIN] == list(PIPELINE_CHAIN)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert all(e["args"]["path"] == "promoted" for e in spans)
+        # Chrome trace-event JSON shape: metadata + duration events only
+        assert {e["ph"] for e in doc["traceEvents"]} <= {"M", "X"}
+        # per-trace lookup of an unknown id 404s
+        status, _, body = _http(sc.port, "/waf/v1/trace?trace_id=" + "ef" * 16)
+        assert status == 404 and b"not recorded" in body
+    finally:
+        sc.stop()
+
+
+def test_frontend_parity_response_traceparent(engine):
+    answers = {}
+    for frontend in ("async", "threaded"):
+        sc = _sidecar(engine, frontend, trace_sample_rate=1.0)
+        sc.start()
+        try:
+            _wait_promoted(sc)
+            status, headers, _ = _http(
+                sc.port, "/?q=evilmonkey", headers={"traceparent": TP}
+            )
+            assert status == 403
+            answers[frontend] = headers["traceparent"]
+        finally:
+            sc.stop()
+    assert answers["async"] == answers["threaded"]
+    assert parse_traceparent(answers["async"])[0] == TRACE_ID
+
+
+@pytest.mark.parametrize("frontend", ["async", "threaded"])
+def test_sampling_off_echoes_but_never_writes(engine, frontend):
+    sc = _sidecar(engine, frontend, trace_sample_rate=0.0)
+    sc.start()
+    try:
+        _wait_promoted(sc)
+        for i in range(8):
+            status, headers, _ = _http(
+                sc.port, f"/?q=clean{i}", headers={"traceparent": TP}
+            )
+            assert status == 200
+            # context propagation still works with recording off
+            assert headers["traceparent"] == format_traceparent(
+                TRACE_ID, derive_span_id(TRACE_ID, "cd" * 8), 1
+            )
+        # untraced requests carry no header at all
+        status, headers, _ = _http(sc.port, "/?q=clean")
+        assert status == 200 and "traceparent" not in headers
+        assert sc.tracer.writes == 0
+        assert sc.stats()["tracing"]["writes"] == 0
+        status, _, body = _http(sc.port, "/waf/v1/trace")
+        assert status == 200
+        assert json.loads(body)["otherData"]["traces"] == 0
+    finally:
+        sc.stop()
+
+
+def test_build_info_and_process_gauges_exported(engine):
+    sc = _sidecar(engine, "async")
+    sc.start()
+    try:
+        _wait_promoted(sc)
+        status, _, body = _http(sc.port, "/waf/v1/metrics")
+        assert status == 200
+        text = body.decode()
+        assert 'cko_build_info{' in text and 'version="' in text
+        assert "cko_process_resident_memory_bytes" in text
+        assert "cko_process_open_fds" in text
+        assert "cko_traces_recorded_total" in text
+    finally:
+        sc.stop()
+
+
+def test_profile_endpoint_denied_without_token(engine):
+    sc = _sidecar(engine, "async")
+    sc.start()
+    try:
+        _wait_promoted(sc)
+        status, _, _ = _http(
+            sc.port,
+            "/waf/v1/profile",
+            method="POST",
+            body=json.dumps({"action": "start"}).encode(),
+        )
+        assert status == 403  # profiling is never anonymous
+    finally:
+        sc.stop()
