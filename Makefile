@@ -79,6 +79,10 @@ compile.smoke:  ## Cold-compile ceiling gate: crs-lite wall + minimized-state + 
 trace.smoke:  ## Flight-recorder gate: sampling off vs on within 5% req/s, complete span chains per serving path.
 	$(PYTHON) hack/trace_smoke.py
 
+.PHONY: extproc.smoke
+extproc.smoke:  ## Envoy e2e gate: ftw corpus through a real Envoy -> ext_proc, verdicts bit-identical to the HTTP frontend. Loud skip when no Envoy binary.
+	$(PYTHON) hack/extproc_smoke.py
+
 .PHONY: metrics.lint
 metrics.lint:  ## Metric catalog drift: every registered cko_*/waf_* metric documented, no dead doc entries.
 	$(PYTHON) hack/metrics_lint.py

@@ -100,6 +100,23 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         " legacy ThreadingHTTPServer escape hatch",
     )
     p.add_argument(
+        "--extproc-port",
+        type=int,
+        default=None,
+        help="Envoy ext_proc gRPC listener port (docs/EXTPROC.md);"
+        " unset reads $CKO_EXTPROC_PORT, default off — the gateway"
+        " attachment surface only opens when asked for. 0 binds an"
+        " ephemeral port",
+    )
+    p.add_argument(
+        "--extproc-impl",
+        choices=["auto", "native", "grpcio"],
+        default="auto",
+        help="ext_proc transport: 'auto' serves via grpcio when"
+        " importable and falls back to the dependency-free HTTP/2"
+        " subset; pin with 'native'/'grpcio' (or $CKO_EXTPROC_IMPL)",
+    )
+    p.add_argument(
         "--audit-log",
         default="",
         help="audit log destination: '-' for stdout (SecAuditLog /dev/stdout"
@@ -295,6 +312,8 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         host=args.bind_address,
         port=args.port,
         frontend=args.frontend,
+        extproc_port=args.extproc_port,
+        extproc_impl=args.extproc_impl,
         request_timeout_s=args.request_timeout_seconds,
         window_deadline_s=args.window_deadline_seconds,
         compile_timeout_s=args.compile_timeout_seconds,

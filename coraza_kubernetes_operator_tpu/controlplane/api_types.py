@@ -203,6 +203,26 @@ class IstioDriverConfig:
 
 
 @dataclass
+class GatewayAttachmentConfig:
+    """Attach the tpu-engine to live gateway traffic via Envoy ext_proc
+    (docs/EXTPROC.md): the controller renders an ``EnvoyFilter`` that
+    registers the engine Service as an ext_proc cluster and inserts the
+    ``envoy.filters.http.ext_proc`` HTTP filter on the selected gateway
+    workloads — the reference's ``pluginConfig`` wiring, rebuilt for the
+    first-party data plane."""
+
+    # Istio workloadSelector for the gateway pods, {"matchLabels": {...}}
+    # — same shape the WasmPlugin gateway mode requires.
+    workload_selector: dict | None = None
+
+    def validate(self) -> None:
+        if not (self.workload_selector and self.workload_selector.get("matchLabels")):
+            raise ValidationError(
+                "gatewayAttachment.workloadSelector is required"
+            )
+
+
+@dataclass
 class TpuDriverConfig:
     """The tpu-batch engine mode (north star): deploys the ``tpu-engine``
     sidecar that evaluates batched requests on TPU and polls the ruleset
@@ -213,12 +233,26 @@ class TpuDriverConfig:
     rule_set_cache_server: RuleSetCacheServerConfig | None = None
     max_batch_size: int = 2048
     max_batch_delay_ms: int = 2
+    # ext_proc gRPC port on the engine pods/Service (docs/EXTPROC.md).
+    ext_proc_port: int = 9091
+    # When set, the engine is attached to gateway traffic with an
+    # EnvoyFilter; absent, the ext_proc listener still opens but nothing
+    # routes to it until an operator wires their own filter.
+    gateway_attachment: GatewayAttachmentConfig | None = None
 
     def validate(self) -> None:
         if self.replicas < 1:
             raise ValidationError("driver.tpu.replicas must be >= 1")
         if not 1 <= self.max_batch_size <= 1 << 20:
             raise ValidationError("driver.tpu.maxBatchSize out of range")
+        if not 1 <= self.ext_proc_port <= 65535:
+            raise ValidationError("driver.tpu.extProcPort out of range")
+        if self.ext_proc_port == 9090:
+            raise ValidationError(
+                "driver.tpu.extProcPort collides with the HTTP port 9090"
+            )
+        if self.gateway_attachment is not None:
+            self.gateway_attachment.validate()
         if self.rule_set_cache_server is not None:
             poll = self.rule_set_cache_server.poll_interval_seconds
             if not MIN_POLL_SECONDS <= poll <= MAX_POLL_SECONDS:

@@ -133,15 +133,7 @@ class EngineReconciler:
             metadata=ObjectMeta(
                 name=f"{WASM_PLUGIN_NAME_PREFIX}{engine.metadata.name}",
                 namespace=engine.metadata.namespace,
-                owner_references=[
-                    {
-                        "apiVersion": engine.api_version,
-                        "kind": engine.kind,
-                        "name": engine.metadata.name,
-                        "uid": engine.metadata.uid,
-                        "controller": True,
-                    }
-                ],
+                owner_references=self._owner_refs(engine),
             ),
             spec={
                 "url": wasm.image,
@@ -156,20 +148,36 @@ class EngineReconciler:
 
     def _provision_tpu(self, engine: Engine) -> ReconcileResult:
         deployment = self.build_tpu_engine_deployment(engine)
-        try:
-            self.store.apply(deployment)
-        except Exception as err:
-            msg = f"Failed to apply tpu-engine Deployment: {err}"
-            self.recorder.event(engine, "Warning", "ProvisioningFailed", msg)
-            set_status_degraded(
-                engine.status.conditions,
-                engine.metadata.generation,
-                "ProvisioningFailed",
-                msg,
-            )
-            self.store.update_status(engine)
-            raise
+        service = self.build_tpu_engine_service(engine)
+        objects: list[tuple[str, Unstructured]] = [
+            ("Deployment", deployment),
+            ("Service", service),
+        ]
+        if engine.spec.driver.tpu.gateway_attachment is not None:
+            objects.append(("EnvoyFilter", self.build_envoy_filter(engine)))
+        for what, obj in objects:
+            try:
+                self.store.apply(obj)
+            except Exception as err:
+                msg = f"Failed to apply tpu-engine {what}: {err}"
+                self.recorder.event(engine, "Warning", "ProvisioningFailed", msg)
+                set_status_degraded(
+                    engine.status.conditions,
+                    engine.metadata.generation,
+                    "ProvisioningFailed",
+                    msg,
+                )
+                self.store.update_status(engine)
+                raise
 
+        if engine.spec.driver.tpu.gateway_attachment is not None:
+            self.recorder.event(
+                engine,
+                "Normal",
+                "GatewayAttached",
+                f"EnvoyFilter {TPU_ENGINE_NAME_PREFIX}{engine.metadata.name} "
+                "routes gateway traffic through ext_proc",
+            )
         msg = f"TPU engine {deployment.metadata.name} provisioned"
         self.recorder.event(engine, "Normal", "TpuEngineProvisioned", msg)
         set_status_ready(
@@ -199,6 +207,7 @@ class EngineReconciler:
             f"--max-batch-size={tpu.max_batch_size}",
             f"--max-batch-delay-ms={tpu.max_batch_delay_ms}",
             f"--drain-budget-seconds={TPU_ENGINE_DRAIN_BUDGET_SECONDS}",
+            f"--extproc-port={tpu.ext_proc_port}",
             "--audit-log=-",  # SecAuditLog /dev/stdout parity; pod logs
         ]  # carry the audit stream the conformance runner matches against
         return Unstructured(
@@ -208,15 +217,7 @@ class EngineReconciler:
                 name=name,
                 namespace=engine.metadata.namespace,
                 labels={"app": name},
-                owner_references=[
-                    {
-                        "apiVersion": engine.api_version,
-                        "kind": engine.kind,
-                        "name": engine.metadata.name,
-                        "uid": engine.metadata.uid,
-                        "controller": True,
-                    }
-                ],
+                owner_references=self._owner_refs(engine),
             ),
             spec={
                 "replicas": tpu.replicas,
@@ -236,7 +237,13 @@ class EngineReconciler:
                                 "name": "tpu-engine",
                                 "image": tpu.image,
                                 "args": args,
-                                "ports": [{"containerPort": 9090, "name": "http"}],
+                                "ports": [
+                                    {"containerPort": 9090, "name": "http"},
+                                    {
+                                        "containerPort": tpu.ext_proc_port,
+                                        "name": "extproc",
+                                    },
+                                ],
                                 # Liveness = the process answers; readiness
                                 # = a ruleset is loaded and the serving mode
                                 # is not broken (sidecar/server.py). Split
@@ -281,6 +288,163 @@ class EngineReconciler:
                 },
             },
         )
+
+    def build_tpu_engine_service(self, engine: Engine) -> Unstructured:
+        """ClusterIP Service in front of the engine pods — the stable DNS
+        name the EnvoyFilter's ext_proc cluster (and anything else in the
+        mesh) dials instead of pod IPs."""
+        tpu = engine.spec.driver.tpu
+        name = f"{TPU_ENGINE_NAME_PREFIX}{engine.metadata.name}"
+        return Unstructured(
+            kind="Service",
+            api_version="v1",
+            metadata=ObjectMeta(
+                name=name,
+                namespace=engine.metadata.namespace,
+                labels={"app": name},
+                owner_references=self._owner_refs(engine),
+            ),
+            spec={
+                "selector": {"app": name},
+                "ports": [
+                    {"name": "http", "port": 9090, "targetPort": "http"},
+                    {
+                        "name": "grpc-extproc",  # istio protocol sniffing
+                        "port": tpu.ext_proc_port,
+                        "targetPort": "extproc",
+                    },
+                ],
+            },
+        )
+
+    def build_envoy_filter(self, engine: Engine) -> Unstructured:
+        """EnvoyFilter attaching the engine to gateway traffic via ext_proc
+        (docs/EXTPROC.md): one CLUSTER patch registering the engine Service
+        as an http2 cluster, one HTTP_FILTER patch inserting
+        ``envoy.filters.http.ext_proc`` before the router with the same
+        processing mode the sidecar serves (request headers + buffered
+        body, response side skipped). ``failure_mode_allow`` mirrors the
+        Engine's failurePolicy so Envoy-side stream failures degrade the
+        same way the engine itself would."""
+        tpu = engine.spec.driver.tpu
+        name = f"{TPU_ENGINE_NAME_PREFIX}{engine.metadata.name}"
+        cluster_name = f"{name}-extproc"
+        service_host = f"{name}.{engine.metadata.namespace}.svc.cluster.local"
+        return Unstructured(
+            kind="EnvoyFilter",
+            api_version="networking.istio.io/v1alpha3",
+            metadata=ObjectMeta(
+                name=name,
+                namespace=engine.metadata.namespace,
+                labels={"app": name},
+                owner_references=self._owner_refs(engine),
+            ),
+            spec={
+                "workloadSelector": {
+                    "labels": (
+                        tpu.gateway_attachment.workload_selector or {}
+                    ).get("matchLabels", {})
+                },
+                "configPatches": [
+                    {
+                        "applyTo": "CLUSTER",
+                        "match": {"context": "GATEWAY"},
+                        "patch": {
+                            "operation": "ADD",
+                            "value": {
+                                "name": cluster_name,
+                                "type": "STRICT_DNS",
+                                "connect_timeout": "1s",
+                                "typed_extension_protocol_options": {
+                                    "envoy.extensions.upstreams.http.v3.HttpProtocolOptions": {
+                                        "@type": (
+                                            "type.googleapis.com/envoy.extensions."
+                                            "upstreams.http.v3.HttpProtocolOptions"
+                                        ),
+                                        "explicit_http_config": {
+                                            "http2_protocol_options": {}
+                                        },
+                                    }
+                                },
+                                "load_assignment": {
+                                    "cluster_name": cluster_name,
+                                    "endpoints": [
+                                        {
+                                            "lb_endpoints": [
+                                                {
+                                                    "endpoint": {
+                                                        "address": {
+                                                            "socket_address": {
+                                                                "address": service_host,
+                                                                "port_value": tpu.ext_proc_port,
+                                                            }
+                                                        }
+                                                    }
+                                                }
+                                            ]
+                                        }
+                                    ],
+                                },
+                            },
+                        },
+                    },
+                    {
+                        "applyTo": "HTTP_FILTER",
+                        "match": {
+                            "context": "GATEWAY",
+                            "listener": {
+                                "filterChain": {
+                                    "filter": {
+                                        "name": "envoy.filters.network.http_connection_manager",
+                                        "subFilter": {
+                                            "name": "envoy.filters.http.router"
+                                        },
+                                    }
+                                }
+                            },
+                        },
+                        "patch": {
+                            "operation": "INSERT_BEFORE",
+                            "value": {
+                                "name": "envoy.filters.http.ext_proc",
+                                "typed_config": {
+                                    "@type": (
+                                        "type.googleapis.com/envoy.extensions."
+                                        "filters.http.ext_proc.v3.ExternalProcessor"
+                                    ),
+                                    "grpc_service": {
+                                        "envoy_grpc": {
+                                            "cluster_name": cluster_name
+                                        },
+                                        "timeout": "5s",
+                                    },
+                                    "failure_mode_allow": (
+                                        engine.spec.failure_policy == "allow"
+                                    ),
+                                    "processing_mode": {
+                                        "request_header_mode": "SEND",
+                                        "request_body_mode": "BUFFERED",
+                                        "response_header_mode": "SKIP",
+                                        "response_body_mode": "NONE",
+                                    },
+                                },
+                            },
+                        },
+                    },
+                ],
+            },
+        )
+
+    def _owner_refs(self, engine: Engine) -> list[dict]:
+        return [
+            {
+                "apiVersion": engine.api_version,
+                "kind": engine.kind,
+                "name": engine.metadata.name,
+                "uid": engine.metadata.uid,
+                "controller": True,
+            }
+        ]
 
     # -- failure path ---------------------------------------------------------
 

@@ -15,6 +15,7 @@ from .api_types import (
     DriverConfig,
     Engine,
     EngineSpec,
+    GatewayAttachmentConfig,
     IstioDriverConfig,
     IstioWasmConfig,
     ObjectMeta,
@@ -100,11 +101,22 @@ def object_from_manifest(doc: dict):
             )
         if "tpu" in driver_doc:
             tpu = driver_doc["tpu"] or {}
+            attach_doc = tpu.get("gatewayAttachment")
             driver.tpu = TpuDriverConfig(
                 image=tpu.get("image", TpuDriverConfig.image),
                 replicas=int(tpu.get("replicas", 1)),
                 max_batch_size=int(tpu.get("maxBatchSize", 2048)),
                 max_batch_delay_ms=int(tpu.get("maxBatchDelayMs", 2)),
+                ext_proc_port=int(
+                    tpu.get("extProcPort", TpuDriverConfig.ext_proc_port)
+                ),
+                gateway_attachment=(
+                    GatewayAttachmentConfig(
+                        workload_selector=attach_doc.get("workloadSelector")
+                    )
+                    if attach_doc is not None
+                    else None
+                ),
                 rule_set_cache_server=_cache_server_from(
                     tpu.get("ruleSetCacheServer")
                 ),
@@ -158,7 +170,15 @@ def object_to_manifest(obj) -> dict:
                 "replicas": tpu.replicas,
                 "maxBatchSize": tpu.max_batch_size,
                 "maxBatchDelayMs": tpu.max_batch_delay_ms,
+                "extProcPort": tpu.ext_proc_port,
             }
+            if tpu.gateway_attachment is not None:
+                attach_doc: dict = {}
+                if tpu.gateway_attachment.workload_selector:
+                    attach_doc["workloadSelector"] = (
+                        tpu.gateway_attachment.workload_selector
+                    )
+                tpu_doc["gatewayAttachment"] = attach_doc
             if tpu.rule_set_cache_server:
                 tpu_doc["ruleSetCacheServer"] = {
                     "pollIntervalSeconds": tpu.rule_set_cache_server.poll_interval_seconds

@@ -6,6 +6,12 @@ an HTTP sidecar that micro-batches in-flight requests, evaluates each batch
 in one device step (``models/waf_model.eval_waf``), enforces the Engine's
 ``failurePolicy``, and hot-reloads rules through the same cache-poll
 contract the WASM plugin uses (uuid change ⇒ recompile ⇒ swap tables).
+
+Since ISSUE 15 the sidecar also carries a gRPC ``ext_proc`` data plane
+(``extproc.py``, docs/EXTPROC.md): a real Envoy attaches via
+``envoy.filters.http.ext_proc`` and the same verdict path answers
+ProcessingRequest streams — ``ExtProcFrontend``/``ExtProcClient`` are
+imported lazily by ``server.py`` so the base import stays light.
 """
 
 from .batcher import MicroBatcher

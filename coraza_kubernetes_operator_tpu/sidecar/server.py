@@ -288,6 +288,16 @@ class SidecarConfig:
     # once the live file would exceed this many bytes. None reads
     # CKO_AUDIT_MAX_BYTES (default 0 = unbounded).
     audit_max_bytes: int | None = None
+    # -- Envoy ext_proc data plane (docs/EXTPROC.md) -------------------------
+    # gRPC ExternalProcessor listener port. None reads CKO_EXTPROC_PORT;
+    # unset/empty keeps the surface closed (the default — it only opens
+    # when an operator or the Engine controller asks for it). 0 binds an
+    # ephemeral port (tests). The resolved bound port is written back.
+    extproc_port: int | None = None
+    # "auto" serves via grpcio when importable and falls back to the
+    # dependency-free HTTP/2 subset otherwise; pin with "native" /
+    # "grpcio" (or CKO_EXTPROC_IMPL while the field stays "auto").
+    extproc_impl: str = "auto"
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -1085,6 +1095,27 @@ class TpuEngineSidecar:
             from .ingest import AsyncIngestFrontend
 
             self._frontend = AsyncIngestFrontend(self)
+        # -- ext_proc data plane (docs/EXTPROC.md) --------------------------
+        # The gateway attachment surface: a gRPC ExternalProcessor server
+        # sharing this sidecar's reply builders, batcher, governor, and
+        # tracer. Off unless a port is configured (flag or env).
+        self._extproc = None
+        extproc_port = config.extproc_port
+        if extproc_port is None:
+            raw = os.environ.get("CKO_EXTPROC_PORT", "").strip()
+            if raw:
+                try:
+                    extproc_port = int(raw)
+                except ValueError as err:
+                    log.error("invalid CKO_EXTPROC_PORT; ext_proc stays off", err)
+        if extproc_port is not None and extproc_port >= 0:
+            from .extproc import ExtProcFrontend
+
+            self._extproc = ExtProcFrontend(
+                self, extproc_port, impl=config.extproc_impl
+            )
+            config.extproc_port = self._extproc.port
+            config.extproc_impl = self._extproc.impl
         self.metrics.gauge(
             "cko_ingest_connections",
             "Open connections on the async ingest frontend",
@@ -1101,6 +1132,31 @@ class TpuEngineSidecar:
             "cko_ingest_aborted_total",
             "Connections force-closed when the shutdown drain budget expired",
         ).set_function(lambda: float(self.governor.aborted_total))
+        # -- ext_proc frontend (docs/EXTPROC.md) ----------------------------
+        self.metrics.gauge(
+            "cko_extproc_connections",
+            "Open ext_proc transport connections (native) / live streams (grpcio)",
+        ).set_function(lambda: float(self._extproc_stat("connections")))
+        self.metrics.gauge(
+            "cko_extproc_streams_total",
+            "ext_proc streams admitted (one per proxied HTTP request)",
+        ).set_function(lambda: float(self._extproc_stat("streams_total")))
+        self.metrics.gauge(
+            "cko_extproc_messages_total",
+            "ProcessingRequest messages decoded off ext_proc streams",
+        ).set_function(lambda: float(self._extproc_stat("messages_total")))
+        self.metrics.gauge(
+            "cko_extproc_immediate_total",
+            "ImmediateResponses sent (deny / shed / 408 / 413 / fail-closed)",
+        ).set_function(lambda: float(self._extproc_stat("immediate_total")))
+        self.metrics.gauge(
+            "cko_extproc_continue_total",
+            "CONTINUE responses sent (allow / fail-open as header mutations)",
+        ).set_function(lambda: float(self._extproc_stat("continue_total")))
+        self.metrics.gauge(
+            "cko_extproc_bytes_total",
+            "Request header+body bytes buffered off ext_proc streams",
+        ).set_function(lambda: float(self._extproc_stat("bytes_total")))
         # -- ingress governance (docs/SERVING.md "Overload & limits") -------
         gov = self.governor
         self.metrics.gauge(
@@ -1147,6 +1203,10 @@ class TpuEngineSidecar:
 
     def _frontend_stat(self, field: str):
         fe = getattr(self, "_frontend", None)
+        return 0 if fe is None else getattr(fe, field, 0)
+
+    def _extproc_stat(self, field: str):
+        fe = getattr(self, "_extproc", None)
         return 0 if fe is None else getattr(fe, field, 0)
 
     def _on_batch(
@@ -2118,6 +2178,11 @@ class TpuEngineSidecar:
                 if self._frontend is not None
                 else {"mode": "threaded"}
             ),
+            "extproc": (
+                self._extproc.stats()
+                if self._extproc is not None
+                else {"enabled": False}
+            ),
             "ingress": {
                 **self.governor.stats(),
                 "window_bytes_pending": self.batcher.pending_bytes(),
@@ -2163,6 +2228,8 @@ class TpuEngineSidecar:
                 target=self._httpd.serve_forever, name="sidecar-http", daemon=True
             )
             self._serve_thread.start()
+        if self._extproc is not None:
+            self._extproc.start()
         log.info(
             "tpu-engine sidecar started",
             addr=f":{self.port}",
@@ -2194,6 +2261,8 @@ class TpuEngineSidecar:
         # within the drain budget), persist the serving state, exit.
         t0 = _time.monotonic()
         self.begin_drain()
+        if self._extproc is not None:
+            self._extproc.stop()
         if self._frontend is not None:
             self._frontend.stop()
         else:

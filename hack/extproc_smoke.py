@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Envoy → ext_proc end-to-end smoke (ISSUE 15 CI gate).
+
+Closes the loop the unit tests can't: a REAL Envoy proxy (static binary)
+serving live HTTP traffic, with the tpu-engine sidecar attached as its
+``envoy.filters.http.ext_proc`` external processor — the exact filter
+config the operator's EnvoyFilter manifest installs on a gateway
+(docs/EXTPROC.md). The bundled ftw corpus is replayed twice:
+
+- directly against the sidecar's HTTP frontend (the reference verdict);
+- through Envoy, whose listener runs ext_proc → our gRPC server →
+  the same ``filter_reply`` → either an ImmediateResponse (deny) or a
+  CONTINUE that lets the request reach a local echo upstream (allow).
+
+For every stage that traverses the WAF, status, ``x-waf-action``,
+``x-waf-rule-id`` and refusal bodies must match byte-for-byte. Stages
+Envoy itself refuses before ext_proc (deliberately malformed corpus
+framing its HTTP/1.1 codec rejects) are excluded and reported.
+
+Envoy discovery, in order: ``$CKO_ENVOY_BIN`` → ``envoy`` on PATH →
+cached ``build/envoy-<ver>`` → download of the official static release
+binary. When no binary can be obtained (sandboxed/offline CI), the
+smoke prints a LOUD skip notice and exits 0 — degraded, never silent.
+
+Usage: extproc_smoke.py [--impl native|grpcio] (env: CKO_ENVOY_BIN,
+CKO_ENVOY_VERSION, CKO_EXTPROC_SMOKE_IMPL). Exit 0 on pass/skip; 1 with
+a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import platform
+import shutil
+import socket
+import stat
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+ENVOY_VERSION = os.environ.get("CKO_ENVOY_VERSION", "1.30.2")
+ENVOY_URL = (
+    "https://github.com/envoyproxy/envoy/releases/download/"
+    "v{ver}/envoy-{ver}-linux-{arch}"
+)
+
+BOOTSTRAP = """
+static_resources:
+  listeners:
+  - name: ingress
+    address:
+      socket_address: {{ address: 127.0.0.1, port_value: {listen_port} }}
+    filter_chains:
+    - filters:
+      - name: envoy.filters.network.http_connection_manager
+        typed_config:
+          "@type": type.googleapis.com/envoy.extensions.filters.network.http_connection_manager.v3.HttpConnectionManager
+          stat_prefix: ingress
+          route_config:
+            name: local
+            virtual_hosts:
+            - name: all
+              domains: ["*"]
+              routes:
+              - match: {{ prefix: "/" }}
+                route: {{ cluster: upstream }}
+          http_filters:
+          - name: envoy.filters.http.ext_proc
+            typed_config:
+              "@type": type.googleapis.com/envoy.extensions.filters.http.ext_proc.v3.ExternalProcessor
+              grpc_service:
+                envoy_grpc: {{ cluster_name: extproc }}
+                timeout: 10s
+              failure_mode_allow: false
+              message_timeout: 10s
+              processing_mode:
+                request_header_mode: SEND
+                request_body_mode: BUFFERED
+                response_header_mode: SKIP
+                response_body_mode: NONE
+          - name: envoy.filters.http.router
+            typed_config:
+              "@type": type.googleapis.com/envoy.extensions.filters.http.router.v3.Router
+  clusters:
+  - name: extproc
+    type: STATIC
+    connect_timeout: 2s
+    typed_extension_protocol_options:
+      envoy.extensions.upstreams.http.v3.HttpProtocolOptions:
+        "@type": type.googleapis.com/envoy.extensions.upstreams.http.v3.HttpProtocolOptions
+        explicit_http_config: {{ http2_protocol_options: {{}} }}
+    load_assignment:
+      cluster_name: extproc
+      endpoints:
+      - lb_endpoints:
+        - endpoint:
+            address:
+              socket_address: {{ address: 127.0.0.1, port_value: {extproc_port} }}
+  - name: upstream
+    type: STATIC
+    connect_timeout: 2s
+    load_assignment:
+      cluster_name: upstream
+      endpoints:
+      - lb_endpoints:
+        - endpoint:
+            address:
+              socket_address: {{ address: 127.0.0.1, port_value: {upstream_port} }}
+"""
+
+
+def skip(reason: str) -> int:
+    line = "=" * 72
+    print(line)
+    print("EXTPROC SMOKE SKIPPED — NO VERDICT EITHER WAY")
+    print(f"reason: {reason}")
+    print("The Envoy e2e gate did NOT run; the ext_proc data plane is")
+    print("only covered by the in-process tests in this build.")
+    print(line)
+    return 0
+
+
+def find_envoy() -> str | None:
+    explicit = os.environ.get("CKO_ENVOY_BIN")
+    if explicit:
+        return explicit if os.access(explicit, os.X_OK) else None
+    on_path = shutil.which("envoy")
+    if on_path:
+        return on_path
+    arch = {"x86_64": "x86_64", "aarch64": "aarch_64"}.get(platform.machine())
+    if sys.platform != "linux" or arch is None:
+        return None
+    cached = REPO / "build" / f"envoy-{ENVOY_VERSION}"
+    if cached.is_file() and os.access(cached, os.X_OK):
+        return str(cached)
+    url = ENVOY_URL.format(ver=ENVOY_VERSION, arch=arch)
+    cached.parent.mkdir(parents=True, exist_ok=True)
+    tmp = cached.with_suffix(".part")
+    print(f"fetching {url} ...")
+    try:
+        with urllib.request.urlopen(url, timeout=120) as resp, open(
+            tmp, "wb"
+        ) as out:
+            shutil.copyfileobj(resp, out)
+    except Exception as err:
+        tmp.unlink(missing_ok=True)
+        print(f"download failed: {err}")
+        return None
+    tmp.chmod(tmp.stat().st_mode | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)
+    tmp.rename(cached)
+    return str(cached)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class EchoUpstream(threading.Thread):
+    """Minimal HTTP/1.1 upstream: answers 200 ``upstream\\n`` and echoes
+    the WAF attribution request headers (the ext_proc header mutation
+    Envoy applied) back as response headers, so the allow path is
+    observable end-to-end."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            conn.settimeout(10)
+            f = conn.makefile("rb")
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                headers = {}
+                while True:
+                    ln = f.readline()
+                    if ln in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = ln.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", 0) or 0)
+                if length:
+                    f.read(length)
+                echoed = b""
+                for key in ("x-waf-action", "x-waf-rule-id"):
+                    if key in headers:
+                        echoed += (
+                            f"{key}: {headers[key]}\r\n".encode("latin-1")
+                        )
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: 9\r\n"
+                    + echoed
+                    + b"Connection: keep-alive\r\n\r\nupstream\n"
+                )
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self.sock.close()
+
+
+def corpus_stages():
+    from coraza_kubernetes_operator_tpu.ftw import load_tests
+
+    out = []
+    for test in load_tests(REPO / "ftw" / "tests"):
+        for st in test.stages:
+            if st.response_status is not None:
+                continue
+            declared = {k.lower(): v for k, v in st.headers}
+            cl = declared.get("content-length")
+            if cl is not None and (not cl.isdigit() or int(cl) != len(st.data)):
+                continue
+            lines = [f"{st.method} {st.uri} HTTP/1.1"]
+            if "host" not in declared:
+                lines.append("Host: parity.test")
+            for k, v in st.headers:
+                lines.append(f"{k}: {v}")
+            if st.data and cl is None:
+                lines.append(f"Content-Length: {len(st.data)}")
+            lines.append("Connection: close")
+            raw = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+            out.append((test.title, raw + st.data))
+    return out
+
+
+def roundtrip(port: int, payload: bytes):
+    """One request, one connection; (status, headers, body) or None when
+    the peer refuses/hangs up without a response."""
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    except OSError:
+        return None
+    try:
+        s.sendall(payload)
+        f = s.makefile("rb")
+        status_line = f.readline()
+        if not status_line:
+            return None
+        status = int(status_line.split()[1])
+        headers = {}
+        while True:
+            ln = f.readline()
+            if ln in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = ln.decode("latin-1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        body = f.read(length) if length else b""
+        return status, headers, body
+    except (OSError, ValueError):
+        return None
+    finally:
+        s.close()
+
+
+def wait_port(port: int, timeout_s: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+def main() -> int:
+    impl = (
+        os.environ.get("CKO_EXTPROC_SMOKE_IMPL")
+        or (sys.argv[sys.argv.index("--impl") + 1]
+            if "--impl" in sys.argv else "native")
+    )
+    envoy = find_envoy()
+    if envoy is None:
+        return skip(
+            "no Envoy binary: $CKO_ENVOY_BIN unset, none on PATH, and the "
+            f"static v{ENVOY_VERSION} release could not be downloaded"
+        )
+    print(f"envoy binary: {envoy}")
+
+    from coraza_kubernetes_operator_tpu.engine import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    rules = (REPO / "ftw" / "rules" / "base.conf").read_text() + (
+        REPO / "ftw" / "rules" / "crs-mini.conf"
+    ).read_text()
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1", port=0, frontend="async",
+            max_batch_size=64, max_batch_delay_ms=1.0,
+            extproc_port=0, extproc_impl=impl,
+        ),
+        engine=WafEngine(rules),
+    )
+    upstream = EchoUpstream()
+    listen_port = free_port()
+    proc = None
+    cfg_path = None
+    envoy_log = None
+    try:
+        sc.start()
+        upstream.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not (
+            sc.ready() and sc.serving_mode() == "promoted"
+        ):
+            time.sleep(0.05)
+        assert sc.serving_mode() == "promoted", "engine never promoted"
+
+        cfg = BOOTSTRAP.format(
+            listen_port=listen_port,
+            extproc_port=sc.config.extproc_port,
+            upstream_port=upstream.port,
+        )
+        fd, cfg_path = tempfile.mkstemp(suffix=".yaml", prefix="extproc-envoy-")
+        with os.fdopen(fd, "w") as f:
+            f.write(cfg)
+        envoy_log = tempfile.NamedTemporaryFile(
+            prefix="extproc-envoy-", suffix=".log", delete=False
+        )
+        proc = subprocess.Popen(
+            [envoy, "-c", cfg_path, "--use-dynamic-base-id",
+             "--log-level", "warn"],
+            stdout=envoy_log, stderr=envoy_log,
+        )
+        if not wait_port(listen_port, 30):
+            print(Path(envoy_log.name).read_text()[-4000:])
+            print(json.dumps({"fail": "envoy listener never came up"}))
+            return 1
+        print(f"envoy up on :{listen_port} → ext_proc :{sc.config.extproc_port}"
+              f" ({sc.config.extproc_impl}) → upstream :{upstream.port}")
+
+        stages = corpus_stages()
+        assert len(stages) >= 10, "corpus too small"
+        compared = skipped = 0
+        mismatches = []
+        actions = set()
+        for title, raw in stages:
+            direct = roundtrip(sc.port, raw)
+            via_envoy = roundtrip(listen_port, raw)
+            if direct is None or via_envoy is None:
+                skipped += 1
+                continue
+            e_status, e_headers, e_body = via_envoy
+            if "x-waf-action" not in e_headers:
+                # Envoy's codec refused the stage before ext_proc saw it
+                # (deliberately broken corpus framing) — not a parity
+                # data point for the WAF.
+                skipped += 1
+                continue
+            d_status, d_headers, d_body = direct
+            action = d_headers.get("x-waf-action")
+            actions.add(action)
+            allowed = d_status == 200 and action in ("allow", "fail-open")
+            want = (
+                d_status,
+                action,
+                d_headers.get("x-waf-rule-id"),
+                None if allowed else d_body,
+            )
+            got = (
+                e_status if not allowed else 200,
+                e_headers.get("x-waf-action"),
+                e_headers.get("x-waf-rule-id"),
+                None if allowed else e_body,
+            )
+            compared += 1
+            if want != got:
+                mismatches.append(
+                    {"title": title, "direct": repr(want), "envoy": repr(got)}
+                )
+        print(
+            f"corpus: {len(stages)} stages, {compared} compared through "
+            f"Envoy, {skipped} refused pre-ext_proc or unreplayable"
+        )
+        if compared < 10:
+            print(json.dumps({"fail": "too few stages traversed Envoy",
+                              "compared": compared}))
+            return 1
+        if not {"deny", "allow"} <= actions:
+            print(json.dumps({"fail": "corpus did not exercise both verdicts",
+                              "actions": sorted(a or "-" for a in actions)}))
+            return 1
+        if mismatches:
+            print(json.dumps({"fail": "verdict divergence",
+                              "mismatches": mismatches[:10]}, indent=2))
+            return 1
+        ext = sc.stats()["extproc"]
+        print(
+            f"PASS: {compared} stages bit-identical through a real Envoy "
+            f"(impl={ext['impl']}, streams={ext['streams_total']}, "
+            f"immediate={ext['immediate_total']}, "
+            f"continue={ext['continue_total']})"
+        )
+        return 0
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        upstream.stop()
+        sc.stop()
+        if cfg_path:
+            os.unlink(cfg_path)
+        if envoy_log is not None:
+            envoy_log.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

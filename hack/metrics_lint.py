@@ -40,6 +40,7 @@ DOC_FILES = (
     "docs/ROLLOUT.md",
     "docs/RECOVERY.md",
     "docs/SERVING.md",
+    "docs/EXTPROC.md",
 )
 
 _REGISTER_RE = re.compile(

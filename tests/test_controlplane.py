@@ -228,6 +228,113 @@ def test_engine_tpu_driver_provisioning(env):
         TPU_ENGINE_TERMINATION_GRACE_SECONDS
         >= TPU_ENGINE_PRESTOP_SLEEP_SECONDS + TPU_ENGINE_DRAIN_BUDGET_SECONDS + 5
     )
+    # ext_proc data plane (docs/EXTPROC.md): the gRPC port rides alongside
+    # the HTTP one and the flag wires the listener on; the probe split
+    # stays on the HTTP port — a hung ext_proc stream must not restart a
+    # pod whose HTTP plane is healthy.
+    assert "--extproc-port=9091" in args
+    ports = {p["name"]: p["containerPort"] for p in container["ports"]}
+    assert ports == {"http": 9090, "extproc": 9091}
+    assert container["livenessProbe"]["httpGet"]["port"] == "http"
+    assert container["readinessProbe"]["httpGet"]["port"] == "http"
+    svc = store.get("Service", NS, "coraza-tpu-engine-eng")
+    svc_ports = {p["name"]: p for p in svc.spec["ports"]}
+    assert svc_ports["http"]["port"] == 9090
+    assert svc_ports["grpc-extproc"]["port"] == 9091
+    assert svc_ports["grpc-extproc"]["targetPort"] == "extproc"
+    assert svc.spec["selector"] == {"app": "coraza-tpu-engine-eng"}
+    assert svc.metadata.owner_references[0]["kind"] == "Engine"
+    # No gateway attachment → no EnvoyFilter.
+    assert store.try_get("EnvoyFilter", NS, "coraza-tpu-engine-eng") is None
+
+
+def test_engine_tpu_gateway_attachment_emits_envoy_filter(env):
+    from coraza_kubernetes_operator_tpu.controlplane.api_types import (
+        GatewayAttachmentConfig,
+    )
+
+    store, _cache, recorder = env
+    store.create(
+        _engine(
+            driver=DriverConfig(
+                tpu=TpuDriverConfig(
+                    ext_proc_port=9191,
+                    gateway_attachment=GatewayAttachmentConfig(
+                        workload_selector={"matchLabels": {"istio": "gw"}}
+                    ),
+                )
+            )
+        )
+    )
+    r = EngineReconciler(store, recorder, cache_server_cluster="cache.svc")
+    r.reconcile(NS, "eng")
+    ef = store.get("EnvoyFilter", NS, "coraza-tpu-engine-eng")
+    assert ef.api_version == "networking.istio.io/v1alpha3"
+    assert ef.spec["workloadSelector"]["labels"] == {"istio": "gw"}
+    assert ef.metadata.owner_references[0]["kind"] == "Engine"
+    patches = {p["applyTo"]: p for p in ef.spec["configPatches"]}
+    assert set(patches) == {"CLUSTER", "HTTP_FILTER"}
+
+    cluster = patches["CLUSTER"]["patch"]["value"]
+    assert patches["CLUSTER"]["patch"]["operation"] == "ADD"
+    assert cluster["name"] == "coraza-tpu-engine-eng-extproc"
+    endpoint = cluster["load_assignment"]["endpoints"][0]["lb_endpoints"][0]
+    addr = endpoint["endpoint"]["address"]["socket_address"]
+    assert addr["address"] == f"coraza-tpu-engine-eng.{NS}.svc.cluster.local"
+    assert addr["port_value"] == 9191
+    # ext_proc is gRPC: the cluster must speak http2.
+    proto = cluster["typed_extension_protocol_options"][
+        "envoy.extensions.upstreams.http.v3.HttpProtocolOptions"
+    ]
+    assert proto["explicit_http_config"] == {"http2_protocol_options": {}}
+
+    http_filter = patches["HTTP_FILTER"]
+    assert http_filter["patch"]["operation"] == "INSERT_BEFORE"
+    sub = http_filter["match"]["listener"]["filterChain"]["filter"]["subFilter"]
+    assert sub["name"] == "envoy.filters.http.router"
+    cfg = http_filter["patch"]["value"]["typed_config"]
+    assert http_filter["patch"]["value"]["name"] == "envoy.filters.http.ext_proc"
+    assert cfg["grpc_service"]["envoy_grpc"]["cluster_name"] == (
+        "coraza-tpu-engine-eng-extproc"
+    )
+    # Engine failurePolicy "fail" → Envoy must fail closed too.
+    assert cfg["failure_mode_allow"] is False
+    # Processing mode must match what sidecar/extproc.py actually serves.
+    assert cfg["processing_mode"] == {
+        "request_header_mode": "SEND",
+        "request_body_mode": "BUFFERED",
+        "response_header_mode": "SKIP",
+        "response_body_mode": "NONE",
+    }
+    # Deployment port follows the configured extProcPort.
+    dep = store.get("Deployment", NS, "coraza-tpu-engine-eng")
+    container = dep.spec["template"]["spec"]["containers"][0]
+    assert "--extproc-port=9191" in container["args"]
+    assert recorder.has_event("Normal", "GatewayAttached")
+
+
+def test_engine_tpu_failure_policy_allow_fails_open_in_envoy(env):
+    from coraza_kubernetes_operator_tpu.controlplane.api_types import (
+        GatewayAttachmentConfig,
+    )
+
+    store, _cache, recorder = env
+    engine = _engine(
+        driver=DriverConfig(
+            tpu=TpuDriverConfig(
+                gateway_attachment=GatewayAttachmentConfig(
+                    workload_selector={"matchLabels": {"istio": "gw"}}
+                ),
+            )
+        )
+    )
+    engine.spec.failure_policy = "allow"
+    store.create(engine)
+    EngineReconciler(store, recorder, "c").reconcile(NS, "eng")
+    ef = store.get("EnvoyFilter", NS, "coraza-tpu-engine-eng")
+    patches = {p["applyTo"]: p for p in ef.spec["configPatches"]}
+    cfg = patches["HTTP_FILTER"]["patch"]["value"]["typed_config"]
+    assert cfg["failure_mode_allow"] is True
 
 
 def test_engine_deleted_cascades_to_owned(env):
@@ -284,6 +391,72 @@ def test_engine_validation_rejections(env, mutate, substring):
     with pytest.raises(ValidationError) as err:
         store.create(engine)
     assert substring in str(err.value)
+
+
+def test_engine_tpu_validation_rejections(env):
+    from coraza_kubernetes_operator_tpu.controlplane.api_types import (
+        GatewayAttachmentConfig,
+    )
+
+    store, _c, _r = env
+    with pytest.raises(ValidationError, match="extProcPort out of range"):
+        store.create(
+            _engine(driver=DriverConfig(tpu=TpuDriverConfig(ext_proc_port=0)))
+        )
+    with pytest.raises(ValidationError, match="collides with the HTTP port"):
+        store.create(
+            _engine(driver=DriverConfig(tpu=TpuDriverConfig(ext_proc_port=9090)))
+        )
+    with pytest.raises(ValidationError, match="workloadSelector is required"):
+        store.create(
+            _engine(
+                driver=DriverConfig(
+                    tpu=TpuDriverConfig(
+                        gateway_attachment=GatewayAttachmentConfig()
+                    )
+                )
+            )
+        )
+
+
+def test_engine_tpu_manifest_round_trip():
+    """extProcPort + gatewayAttachment survive object ⇄ manifest codec —
+    the path every transport (manifest dir, kube API, fake API) shares."""
+    from coraza_kubernetes_operator_tpu.controlplane.api_types import (
+        GatewayAttachmentConfig,
+    )
+    from coraza_kubernetes_operator_tpu.controlplane.manifests import (
+        object_from_manifest,
+        object_to_manifest,
+    )
+
+    engine = _engine(
+        driver=DriverConfig(
+            tpu=TpuDriverConfig(
+                ext_proc_port=9191,
+                gateway_attachment=GatewayAttachmentConfig(
+                    workload_selector={"matchLabels": {"istio": "gw"}}
+                ),
+            )
+        )
+    )
+    doc = object_to_manifest(engine)
+    tpu_doc = doc["spec"]["driver"]["tpu"]
+    assert tpu_doc["extProcPort"] == 9191
+    assert tpu_doc["gatewayAttachment"]["workloadSelector"] == {
+        "matchLabels": {"istio": "gw"}
+    }
+    back = object_from_manifest(doc)
+    assert back.spec.driver.tpu.ext_proc_port == 9191
+    assert back.spec.driver.tpu.gateway_attachment.workload_selector == {
+        "matchLabels": {"istio": "gw"}
+    }
+    # Defaults: no attachment → field absent, port defaults to 9091.
+    plain = object_from_manifest(
+        object_to_manifest(_engine(driver=DriverConfig(tpu=TpuDriverConfig())))
+    )
+    assert plain.spec.driver.tpu.ext_proc_port == 9091
+    assert plain.spec.driver.tpu.gateway_attachment is None
 
 
 def test_ruleset_validation_rejections(env):

@@ -550,7 +550,9 @@ def test_hot_reload_drains_mid_connection():
 
 def _corpus_stage_requests():
     """Every runnable request in the bundled ftw corpus, as raw HTTP/1.1
-    bytes (identical bytes go to both frontends)."""
+    bytes plus the structured (method, uri, headers, data) tuple — the
+    raw bytes replay over the HTTP frontends, the structured form rides
+    the ext_proc stream with the SAME effective header list."""
     from coraza_kubernetes_operator_tpu.ftw import load_tests
 
     out = []
@@ -563,20 +565,38 @@ def _corpus_stage_requests():
             if cl is not None and (not cl.isdigit() or int(cl) != len(stage.data)):
                 continue  # intentionally broken framing would desync reads
             lines = [f"{stage.method} {stage.uri} HTTP/1.1"]
+            headers = []
             if "host" not in declared:
                 lines.append("Host: parity.test")
+                headers.append(("Host", "parity.test"))
             for k, v in stage.headers:
                 lines.append(f"{k}: {v}")
+                headers.append((k, v))
             if stage.data and cl is None:
                 lines.append(f"Content-Length: {len(stage.data)}")
+                headers.append(("Content-Length", str(len(stage.data))))
             lines.append("Connection: close")
+            headers.append(("Connection", "close"))
             raw = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
-            out.append((test.title, raw + stage.data))
+            out.append((
+                test.title,
+                raw + stage.data,
+                (stage.method, stage.uri, headers, stage.data),
+            ))
     return out
 
 
+def _norm_verdict(title, status, action, rule_id, body):
+    """Frontend-agnostic verdict: allowed traffic proceeds upstream on
+    the ext_proc path (CONTINUE — no body of ours on the wire), so the
+    HTTP frontends' ``allowed\\n`` body is excluded from the comparison;
+    every refusal body must match byte-for-byte."""
+    allowed = status == 200 and action in ("allow", "fail-open")
+    return (title, status, action, rule_id, None if allowed else body)
+
+
 @pytest.mark.slow
-def test_ftw_corpus_verdict_parity_threaded_vs_async():
+def test_ftw_corpus_verdict_parity_threaded_vs_async_vs_extproc():
     rules = (REPO / "ftw" / "rules" / "base.conf").read_text() + (
         REPO / "ftw" / "rules" / "crs-mini.conf"
     ).read_text()
@@ -585,13 +605,19 @@ def test_ftw_corpus_verdict_parity_threaded_vs_async():
     assert len(stages) >= 10
     verdicts = {}
     for frontend in ("threaded", "async"):
-        sc = _sidecar(engine, frontend=frontend)
+        # The async leg also carries the ext_proc listener (native impl:
+        # the dependency-free HTTP/2 server) so the gRPC data plane runs
+        # against the very same engine + batcher instance.
+        extproc = {"extproc_port": 0, "extproc_impl": "native"} if (
+            frontend == "async"
+        ) else {}
+        sc = _sidecar(engine, frontend=frontend, **extproc)
         sc.start()
         try:
             assert _wait(sc.ready)
             assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=120)
             got = []
-            for title, raw in stages:
+            for title, raw, _req in stages:
                 (resp,) = _raw(sc.port, raw, 1)
                 assert resp is not None, (frontend, title)
                 status, headers, body = resp
@@ -605,9 +631,41 @@ def test_ftw_corpus_verdict_parity_threaded_vs_async():
                     )
                 )
             verdicts[frontend] = got
+            if frontend == "async":
+                verdicts["extproc"] = _extproc_corpus_verdicts(sc, stages)
         finally:
             sc.stop()
     assert verdicts["async"] == verdicts["threaded"]
+    # Tri-parity: the gRPC data plane must agree with both HTTP frontends
+    # on every stage — same status, same x-waf-* attribution, and
+    # byte-identical refusal bodies.
+    normalized = {
+        leg: [_norm_verdict(*v) for v in verdicts[leg]]
+        for leg in ("threaded", "async", "extproc")
+    }
+    assert normalized["extproc"] == normalized["async"] == normalized["threaded"]
     # The corpus must actually exercise both outcomes.
     actions = {v[2] for v in verdicts["async"]}
     assert "deny" in actions and "allow" in actions
+
+
+def _extproc_corpus_verdicts(sc, stages):
+    from coraza_kubernetes_operator_tpu.sidecar.extproc import ExtProcClient
+
+    client = ExtProcClient("127.0.0.1", sc.config.extproc_port)
+    got = []
+    try:
+        for title, _raw_bytes, (method, uri, headers, data) in stages:
+            out = client.filter(method, uri, headers, data)
+            got.append(
+                (
+                    title,
+                    out["status"],
+                    out["headers"].get("x-waf-action"),
+                    out["headers"].get("x-waf-rule-id"),
+                    None if out["allowed"] else out["body"],
+                )
+            )
+    finally:
+        client.close()
+    return got
