@@ -63,6 +63,10 @@ ingest.smoke:  ## Async frontend gate: async >= 2x threaded req/s, verdicts iden
 ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontends, zero leaks.
 	$(PYTHON) hack/ingest_fuzz.py
 
+.PHONY: sched.smoke
+sched.smoke:  ## Adaptive scheduler gate: adaptive p99 <= best static delay, verdicts identical.
+	$(PYTHON) hack/sched_smoke.py
+
 .PHONY: chaos.smoke
 chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm, crash-restart, device loss, poison storm.
 	$(PYTHON) hack/chaos_smoke.py

@@ -287,6 +287,35 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         " live file would exceed this many bytes (default"
         " $CKO_AUDIT_MAX_BYTES or 0 = unbounded; file-backed logs only)",
     )
+    p.add_argument(
+        "--slo-p99-ms",
+        type=float,
+        default=None,
+        help="p99 step-latency target the adaptive scheduler steers"
+        " toward (docs/SERVING.md; default $CKO_SLO_P99_MS or 50)",
+    )
+    p.add_argument(
+        "--tenant-weights",
+        default=None,
+        help="comma-separated tenant=weight pairs for weighted-fair"
+        " admission, e.g. 'gold=3,free=1'; 'default' sets the weight"
+        " for unlisted tenants (default $CKO_TENANT_WEIGHTS or all 1)",
+    )
+    p.add_argument(
+        "--lane-delay-ms",
+        type=float,
+        default=None,
+        help="base micro-batch window for the interactive (headers-only)"
+        " lane in milliseconds; the bulk lane keeps --max-batch-delay-ms"
+        " (default $CKO_LANE_DELAY_MS or the bulk delay)",
+    )
+    p.add_argument(
+        "--disable-adaptive",
+        action="store_true",
+        help="kill switch for the trace-driven adaptive scheduler: lane"
+        " delays, pipeline depth and queue budgets stay at their static"
+        " configured values",
+    )
     args = p.parse_args(argv)
 
     # Wire the persistent compile cache BEFORE any engine compiles: a
@@ -339,6 +368,10 @@ def build_config(argv: list[str] | None = None) -> SidecarConfig:
         trace_sample_rate=args.trace_sample_rate,
         trace_ring=args.trace_ring,
         audit_max_bytes=args.audit_max_bytes,
+        slo_p99_ms=args.slo_p99_ms,
+        tenant_weights=args.tenant_weights,
+        lane_delay_ms=args.lane_delay_ms,
+        adaptive_enabled=not args.disable_adaptive,
     )
 
 

@@ -20,6 +20,22 @@ In-flight depth is bounded (``CKO_PIPELINE_DEPTH``, default 2 — classic
 double buffering), so the existing backpressure path still engages: when
 the device falls behind, windows queue in the submit queue, ``pending()``
 grows, and the server's admission control sheds with 429.
+
+**Priority lanes (overload isolation).** Submissions are classified into
+two independent micro-batch streams: the *interactive* lane (headers-only
+requests — the gateway fast path where ext_proc answers on end-of-stream)
+and the *bulk* lane (bodied requests). Each lane owns its submit queue,
+dispatch thread, batching delay, and in-flight depth gate, so a bodied
+flood saturating the bulk lane's pipeline slots can never queue ahead of
+headers-only windows. Verdict order stays strictly FIFO *per lane* (one
+collector drains a shared in-flight queue; each lane's records enter it
+in dispatch order).
+
+**Weighted-fair admission.** Each lane's submit queue is a deficit-
+round-robin ``_FairQueue`` over per-tenant buckets: at batch-assembly
+time tenants are served in proportion to their configured weights
+(``CKO_TENANT_WEIGHTS``, default equal), so one noisy tenant cannot
+monopolize window slots even before admission control starts shedding.
 """
 
 from __future__ import annotations
@@ -29,6 +45,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
@@ -46,6 +63,188 @@ DEFAULT_MAX_BATCH_DELAY_MS = 1.0
 # host assembly is much faster than the device step AND arrival bursts
 # outpace both.
 DEFAULT_PIPELINE_DEPTH = 2
+
+# Priority lanes: interactive = headers-only (no body to tensorize — the
+# ext_proc answer-on-eos fast path), bulk = bodied. Lane identity is a
+# property of the REQUEST, not the frontend, so every frontend classifies
+# the same way and verdicts cannot depend on the transport.
+LANE_INTERACTIVE = "interactive"
+LANE_BULK = "bulk"
+LANES = (LANE_INTERACTIVE, LANE_BULK)
+
+
+def classify_lane(request) -> str:
+    """Lane for one request: bodied → bulk, headers-only → interactive."""
+    return LANE_BULK if getattr(request, "body", b"") else LANE_INTERACTIVE
+
+
+class _DepthGate:
+    """Counting semaphore with a LIVE-adjustable limit. The adaptive
+    scheduler retunes pipeline depth on a running batcher; a plain
+    ``threading.Semaphore`` cannot shrink, so the gate tracks held slots
+    against a mutable limit under one condition variable. Shrinking
+    never revokes held slots — the pipeline just stops admitting new
+    windows until enough in-flight ones collect."""
+
+    def __init__(self, limit: int) -> None:
+        self._cv = threading.Condition()
+        self._limit = max(1, int(limit))
+        self._held = 0
+
+    @property
+    def limit(self) -> int:
+        with self._cv:
+            return self._limit
+
+    def set_limit(self, limit: int) -> None:
+        with self._cv:
+            self._limit = max(1, int(limit))
+            self._cv.notify_all()
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._held >= self._limit:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            self._held += 1
+            return True
+
+    def release(self) -> None:
+        with self._cv:
+            if self._held > 0:
+                self._held -= 1
+            self._cv.notify()
+
+
+class _FairQueue:
+    """Deficit-round-robin tenant-fair submit queue, shaped like the
+    ``queue.Queue`` subset the dispatch loop uses (``put`` /
+    ``get(timeout=)`` / ``get_nowait`` / ``qsize``, raising
+    ``queue.Empty``).
+
+    Items are the batcher's queue entries: ``(request, tenant, fut,
+    span)`` triples (cost 1, bucketed by tenant), pre-assembled
+    ``_BlobWindow`` windows (cost 1 — one already-packed unit, bucketed
+    under the default tenant), and ``None`` shutdown sentinels (a
+    control channel with absolute priority so stop() is never stuck
+    behind a backlog).
+
+    DRR: each active tenant bucket holds a deficit counter; serving one
+    item costs 1, a visited bucket that cannot pay earns
+    ``quantum * weight(tenant)`` and the rotation moves on. A bucket
+    leaving the rotation (emptied) forfeits its deficit — the standard
+    reset that stops idle tenants from banking credit. With one active
+    tenant (the common case) every get() is O(1) and order is FIFO."""
+
+    def __init__(self, weight_fn=None, quantum: float = 8.0) -> None:
+        self._cv = threading.Condition()
+        self._control: deque = deque()
+        self._buckets: dict[str | None, deque] = {}
+        self._rotation: deque = deque()
+        self._deficit: dict[str | None, float] = {}
+        self._size = 0
+        # True while the rotation head has not yet earned its quantum
+        # for the current visit: a bucket earns exactly once per visit,
+        # spends the deficit down, then the rotation moves on.
+        self._fresh = True
+        # weight_fn(tenant) -> float; the sidecar wires the governor's
+        # CKO_TENANT_WEIGHTS table. Unset/failing → equal weights.
+        self.weight_fn = weight_fn
+        self.quantum = float(quantum)
+
+    @staticmethod
+    def _tenant_of(item) -> str | None:
+        if isinstance(item, _BlobWindow):
+            return None
+        return item[1]
+
+    def put(self, item) -> None:
+        with self._cv:
+            if item is None:
+                self._control.append(item)
+            else:
+                key = self._tenant_of(item)
+                bucket = self._buckets.get(key)
+                if bucket is None:
+                    bucket = self._buckets[key] = deque()
+                    self._rotation.append(key)
+                    self._deficit[key] = 0.0
+                bucket.append(item)
+                self._size += 1
+            self._cv.notify()
+
+    def get(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._control:
+                    return self._control.popleft()
+                if self._size > 0:
+                    return self._pop_locked()
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise queue.Empty
+                self._cv.wait(remaining)
+
+    def get_nowait(self):
+        with self._cv:
+            if self._control:
+                return self._control.popleft()
+            if self._size > 0:
+                return self._pop_locked()
+            raise queue.Empty
+
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size + len(self._control)
+
+    def tenant_backlog(self) -> dict:
+        """Queued-item count per tenant bucket (stats + tenant-scoped
+        admission control)."""
+        with self._cv:
+            return {k: len(b) for k, b in self._buckets.items()}
+
+    def _weight(self, key) -> float:
+        w = 1.0
+        if self.weight_fn is not None:
+            try:
+                w = float(self.weight_fn(key))
+            except Exception:  # a broken weight table must not stall serving
+                w = 1.0
+        # Weight 0/negative would never earn deficit and starve forever;
+        # clamp to a tiny positive share instead (shed belongs to
+        # admission control, not the queue).
+        return w if w > 0.0 else 1e-3
+
+    def _pop_locked(self):
+        while True:
+            key = self._rotation[0]
+            bucket = self._buckets[key]
+            if self._fresh:
+                # Earn once per visit; unspent deficit carries across
+                # visits so sub-1 weighted quanta still add up.
+                self._deficit[key] += self.quantum * self._weight(key)
+                self._fresh = False
+            if self._deficit[key] < 1.0:
+                self._rotation.rotate(-1)
+                self._fresh = True
+                continue
+            item = bucket.popleft()
+            self._deficit[key] -= 1.0
+            self._size -= 1
+            if not bucket:
+                del self._buckets[key]
+                del self._deficit[key]
+                self._rotation.popleft()
+                self._fresh = True
+            return item
 
 
 def _nearest_rank(sorted_samples: list[float], p: float) -> float:
@@ -156,6 +355,9 @@ class _BlobWindow:
     # whose entries are SpanContext/None. Untraced windows pay one
     # attribute read in the collect stage.
     spans: list | None = None
+    # Priority lane the assembling frontend classified this window into
+    # (per-lane accounting must survive the queue round-trip).
+    lane: str = LANE_BULK
 
 
 @dataclass
@@ -170,6 +372,9 @@ class _WindowRecord:
     # blob's request index space and the collect stage stitches verdicts
     # back into one list for the window future.
     split: bool = False
+    # Lane that dispatched this window: the collector releases the SAME
+    # lane's depth slot.
+    lane: str = LANE_BULK
 
 
 @dataclass
@@ -208,6 +413,8 @@ class MicroBatcher:
         max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
         phase_split: bool = False,
         pipeline_depth: int | None = None,
+        lane_delay_ms: float | None = None,
+        weight_fn=None,
     ):
         # phase_split: evaluate phase-1 (headers) before body ingest —
         # early denials never tensorize their bodies (SURVEY §3.4). The
@@ -230,18 +437,45 @@ class MicroBatcher:
                 os.environ.get("CKO_PIPELINE_DEPTH", str(DEFAULT_PIPELINE_DEPTH))
             )
         self.pipeline_depth = max(1, int(pipeline_depth))
-        self._queue: queue.Queue[
-            tuple[HttpRequest, str | None, Future, object] | None
-        ] = queue.Queue()
+        # Per-lane batching delay: bulk inherits max_batch_delay_ms; the
+        # interactive (headers-only) lane defaults to the SAME value so a
+        # single-lane workload behaves exactly as before, and can be
+        # tightened via lane_delay_ms / the adaptive scheduler. Read
+        # fresh at every window open, so a live retune lands on the next
+        # window without a restart.
+        interactive_delay_s = (
+            self.max_batch_delay_s
+            if lane_delay_ms is None
+            else max(0.0, float(lane_delay_ms)) / 1e3
+        )
+        self.lane_delay_s: dict[str, float] = {
+            LANE_INTERACTIVE: interactive_delay_s,
+            LANE_BULK: self.max_batch_delay_s,
+        }
+        # One DRR submit queue + dispatch thread + depth gate per lane.
+        # The in-flight queue and collector stay SHARED: each lane's
+        # records enter in its own dispatch order (per-lane FIFO verdict
+        # order holds), and the single collector keeps the existing
+        # resolve-order invariants without a second drain path.
+        self._queues: dict[str, _FairQueue] = {
+            lane: _FairQueue(weight_fn=weight_fn) for lane in LANES
+        }
         self._inflight: queue.Queue[_WindowRecord | None] = queue.Queue()
-        self._depth_sem = threading.Semaphore(self.pipeline_depth)
+        self._depth_gates: dict[str, _DepthGate] = {
+            lane: _DepthGate(self.pipeline_depth) for lane in LANES
+        }
         self._inflight_lock = threading.Lock()
         self._inflight_count = 0
-        self._window_open = False
-        self._thread: threading.Thread | None = None
+        # Count of lanes currently assembling/dispatching a window (the
+        # `busy` signal must cover both dispatch threads).
+        self._windows_open = 0
+        self._threads: dict[str, threading.Thread] = {}
         self._collector: threading.Thread | None = None
         self._running = False
         self.stats = BatcherStats()
+        # Per-lane window/request counters (cko_lane_* gauges).
+        self.lane_windows: dict[str, int] = {lane: 0 for lane in LANES}
+        self.lane_requests: dict[str, int] = {lane: 0 for lane in LANES}
         # Degraded-mode hooks (sidecar/degraded.py): device evaluation
         # outcomes feed the circuit breaker. Missing-engine windows are
         # NOT device failures and bypass these.
@@ -312,12 +546,12 @@ class MicroBatcher:
         self._collector_join_s = 30.0
         # Requests inside queued-but-not-dispatched blob windows; the
         # admission-control signal must count them (a blob window is one
-        # queue item but n_req requests of backlog).
-        self._blob_pending = 0
+        # queue item but n_req requests of backlog). Per lane.
+        self._blob_pending: dict[str, int] = {lane: 0 for lane in LANES}
         # Bytes of those queued blob windows — the ingress byte ledger
         # (sidecar.governor) reports them so assembled-but-undispatched
         # windows are visible in the memory-backpressure picture.
-        self._blob_pending_bytes = 0
+        self._blob_pending_bytes: dict[str, int] = {lane: 0 for lane in LANES}
 
     @property
     def busy(self) -> bool:
@@ -326,7 +560,21 @@ class MicroBatcher:
         from "a (re)compile or big step is in flight" and extend their
         timeout instead of failing mid-compile."""
         with self._inflight_lock:
-            return self._window_open or self._inflight_count > 0
+            return self._windows_open > 0 or self._inflight_count > 0
+
+    # -- adaptive knobs (sidecar/scheduler.py) -------------------------------
+
+    def set_lane_delay(self, lane: str, delay_ms: float) -> None:
+        """Retune one lane's batching delay; takes effect on the next
+        window that lane opens."""
+        self.lane_delay_s[lane] = max(0.0, float(delay_ms)) / 1e3
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Retune the bounded in-flight depth for BOTH lanes. Shrinking
+        never revokes in-flight windows — admission of new ones waits."""
+        self.pipeline_depth = max(1, int(depth))
+        for gate in self._depth_gates.values():
+            gate.set_limit(self.pipeline_depth)
 
     def inflight_windows(self) -> int:
         """Windows dispatched but not yet collected (the
@@ -336,8 +584,12 @@ class MicroBatcher:
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(target=self._run, name="batcher", daemon=True)
-        self._thread.start()
+        for lane in LANES:
+            t = threading.Thread(
+                target=self._run, args=(lane,), name=f"batcher-{lane}", daemon=True
+            )
+            self._threads[lane] = t
+            t.start()
         self._collector = threading.Thread(
             target=self._collect_loop, name="batcher-collect", daemon=True
         )
@@ -359,13 +611,16 @@ class MicroBatcher:
         # evaluated (host fallback) until it passes, then fail fast.
         self._drain_deadline_t = time.monotonic() + max(0.0, self.drain_budget_s)
         self._running = False
-        self._queue.put(None)
-        t = self._thread
-        if t is not None:
+        for lane in LANES:
+            self._queues[lane].put(None)
+        threads = [t for t in self._threads.values() if t is not None]
+        for t in threads:
             t.join(timeout=5)
-        if t is not None and t.is_alive():
+        stragglers = [t for t in threads if t.is_alive()]
+        if stragglers:
             def _sentinel_after_dispatch():
-                t.join()
+                for t in stragglers:
+                    t.join()
                 self._inflight.put(None)
 
             threading.Thread(
@@ -401,12 +656,14 @@ class MicroBatcher:
         budget (host fallback when the device path is gone) — a graceful
         drain loses no verdict; only items past the deadline, or with no
         engine to answer them, fail with ``EngineUnavailable``."""
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                return
-            self._drain_item(item)
+        for lane in LANES:
+            q = self._queues[lane]
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                self._drain_item(item)
 
     # -- graceful drain (docs/RECOVERY.md) -----------------------------------
 
@@ -446,8 +703,8 @@ class MicroBatcher:
             return
         if isinstance(item, _BlobWindow):
             with self._inflight_lock:
-                self._blob_pending -= item.n_req
-                self._blob_pending_bytes -= len(item.blob)
+                self._blob_pending[item.lane] -= item.n_req
+                self._blob_pending_bytes[item.lane] -= len(item.blob)
             self._drain_blob(item)
         else:
             self._drain_triple(item)
@@ -491,47 +748,77 @@ class MicroBatcher:
         request: HttpRequest,
         tenant: str | None = None,
         span=None,
+        lane: str | None = None,
     ) -> Future:
         """Enqueue one request; the Future resolves to its Verdict.
         ``span`` is an optional flight-recorder SpanContext; the collect
         stage stamps the pipeline spans onto it before the future
-        resolves."""
+        resolves. ``lane`` pins a priority lane; unset, the request is
+        classified by body presence (bodied → bulk)."""
         fut: Future = Future()
         if span is not None:
             span.t_submit = time.monotonic()
-        self._queue.put((request, tenant, fut, span))
+        if lane is None:
+            lane = classify_lane(request)
+        self._queues[lane].put((request, tenant, fut, span))
         return fut
 
-    def submit_window(self, blob: bytes, n_req: int, spans=None) -> Future:
+    def submit_window(
+        self, blob: bytes, n_req: int, spans=None, lane: str = LANE_BULK
+    ) -> Future:
         """Enqueue a pre-assembled ingest window (request blob in the
         ``native.serialize_requests`` format). Dispatched as its own
         window — never coalesced with per-request submissions — on the
         default tenant's engine pinned at dispatch time (reload-safe
         draining, same as per-request windows). The Future resolves to
         the window's ``list[Verdict]``. ``spans`` optionally carries one
-        flight-recorder context per blob request index (or None)."""
+        flight-recorder context per blob request index (or None); the
+        assembling frontend names the ``lane`` it already accumulates
+        per-lane windows for."""
         fut: Future = Future()
         with self._inflight_lock:
-            self._blob_pending += n_req
-            self._blob_pending_bytes += len(blob)
-        self._queue.put(_BlobWindow(blob=blob, n_req=n_req, fut=fut, spans=spans))
+            self._blob_pending[lane] += n_req
+            self._blob_pending_bytes[lane] += len(blob)
+        self._queues[lane].put(
+            _BlobWindow(blob=blob, n_req=n_req, fut=fut, spans=spans, lane=lane)
+        )
         return fut
 
-    def pending(self) -> int:
+    def pending(self, lane: str | None = None) -> int:
         """Requests queued but not yet picked into a window (blob
-        windows count their full request payload)."""
+        windows count their full request payload). ``lane`` scopes the
+        signal to one priority lane; unset, both lanes sum — the global
+        admission-control view."""
+        lanes = LANES if lane is None else (lane,)
         with self._inflight_lock:
-            blob_n = self._blob_pending
+            blob_n = sum(self._blob_pending[ln] for ln in lanes)
         # qsize() also counts queued _BlobWindow items (1 each); their
         # requests are already in blob_n, so subtracting nothing keeps
         # the signal conservative (over-counts by the window count).
-        return self._queue.qsize() + blob_n
+        return sum(self._queues[ln].qsize() for ln in lanes) + blob_n
 
     def pending_bytes(self) -> int:
         """Bytes of blob windows queued but not yet dispatched (the
         stats/ledger view of assembled-window memory)."""
         with self._inflight_lock:
-            return self._blob_pending_bytes
+            return sum(self._blob_pending_bytes.values())
+
+    def tenant_pending(self, tenant: str | None) -> int:
+        """Queued submissions attributed to one tenant across both
+        lanes (tenant-scoped admission control; blob windows ride the
+        default tenant's bucket)."""
+        total = 0
+        for q in self._queues.values():
+            total += q.tenant_backlog().get(tenant, 0)
+        return total
+
+    def tenant_backlog(self) -> dict:
+        """Merged per-tenant queued-item counts across lanes."""
+        merged: dict = {}
+        for q in self._queues.values():
+            for k, v in q.tenant_backlog().items():
+                merged[k] = merged.get(k, 0) + v
+        return merged
 
     def evaluate(
         self,
@@ -548,10 +835,11 @@ class MicroBatcher:
 
     # -- dispatch stage ------------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self, lane: str = LANE_BULK) -> None:
+        q = self._queues[lane]
         carry = None
         while self._running or carry is not None:
-            item = carry if carry is not None else self._queue.get()
+            item = carry if carry is not None else q.get()
             carry = None
             if item is None:
                 continue
@@ -561,23 +849,25 @@ class MicroBatcher:
                 self._drain_item(item)
                 continue
             with self._inflight_lock:
-                self._window_open = True
+                self._windows_open += 1
             try:
                 if isinstance(item, _BlobWindow):
                     # Pre-assembled window: dispatch as-is, never coalesce.
                     with self._inflight_lock:
-                        self._blob_pending -= item.n_req
-                        self._blob_pending_bytes -= len(item.blob)
-                    self._dispatch_or_fail(item)
+                        self._blob_pending[lane] -= item.n_req
+                        self._blob_pending_bytes[lane] -= len(item.blob)
+                    self._dispatch_or_fail(item, lane)
                     continue
                 window: list[tuple[HttpRequest, str | None, Future]] = [item]
-                deadline = time.monotonic() + self.max_batch_delay_s
+                # The lane delay is read at window open so a live retune
+                # (adaptive scheduler) lands on the very next window.
+                deadline = time.monotonic() + self.lane_delay_s[lane]
                 while len(window) < self.max_batch_size:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     try:
-                        nxt = self._queue.get(timeout=remaining)
+                        nxt = q.get(timeout=remaining)
                     except queue.Empty:
                         break
                     if nxt is None:
@@ -588,17 +878,20 @@ class MicroBatcher:
                         carry = nxt
                         break
                     window.append(nxt)
-                self._dispatch_or_fail(window)
+                self._dispatch_or_fail(window, lane)
             finally:
                 with self._inflight_lock:
-                    self._window_open = False
+                    self._windows_open -= 1
 
-    def _dispatch_or_fail(self, window) -> None:
-        """Acquire an in-flight slot (bounded depth — THE backpressure
-        point: while the device is ``pipeline_depth`` windows behind,
-        assembly blocks here, the submit queue grows, and admission
-        control sheds), then dispatch."""
-        while not self._depth_sem.acquire(timeout=0.1):
+    def _dispatch_or_fail(self, window, lane: str = LANE_BULK) -> None:
+        """Acquire the lane's in-flight slot (bounded depth — THE
+        backpressure point: while the device is ``pipeline_depth``
+        windows behind, assembly blocks here, the submit queue grows,
+        and admission control sheds). Depth gates are per lane, so a
+        bulk flood holding its slots never blocks interactive
+        dispatch."""
+        gate = self._depth_gates[lane]
+        while not gate.acquire(timeout=0.1):
             if not self._running:
                 # Shutdown with the pipeline full: drain the assembled
                 # window off-device instead of failing it. (Blob-backlog
@@ -611,17 +904,22 @@ class MicroBatcher:
                 return
         with self._inflight_lock:
             self._inflight_count += 1
+            self.lane_windows[lane] += 1
+            self.lane_requests[lane] += (
+                window.n_req if isinstance(window, _BlobWindow) else len(window)
+            )
         try:
             if isinstance(window, _BlobWindow):
                 record = self._dispatch_blob(window)
             else:
                 record = self._dispatch_window(window)
+            record.lane = lane
         except BaseException:
             # _dispatch_window is defensive per group; anything escaping
             # it must still release the slot or the pipeline deadlocks.
             with self._inflight_lock:
                 self._inflight_count -= 1
-            self._depth_sem.release()
+            gate.release()
             raise
         self._inflight.put(record)
 
@@ -831,7 +1129,7 @@ class MicroBatcher:
             finally:
                 with self._inflight_lock:
                     self._inflight_count -= 1
-                self._depth_sem.release()
+                self._depth_gates[record.lane].release()
 
     # -- dispatch watchdog ---------------------------------------------------
 

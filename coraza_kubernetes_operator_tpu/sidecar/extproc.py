@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..engine.request import HttpRequest
 from ..utils import get_logger
+from .batcher import LANE_BULK, LANE_INTERACTIVE
 from .degraded import Overloaded
 from .tenants import TENANT_HEADER
 
@@ -770,7 +771,7 @@ class _ExtStream:
 
     __slots__ = (
         "peer", "t_open", "headers", "body", "charged", "done",
-        "await_body", "deadline", "ctx",
+        "await_body", "deadline", "ctx", "tenant",
     )
 
     def __init__(self, peer: str, t_open: float):
@@ -783,6 +784,7 @@ class _ExtStream:
         self.await_body = False
         self.deadline: Optional[float] = None
         self.ctx = None
+        self.tenant: Optional[str] = None
 
 
 class ExtProcEngine:
@@ -814,7 +816,7 @@ class ExtProcEngine:
     def close_stream(self, st: _ExtStream) -> None:
         gov = self.sidecar.governor
         if st.charged:
-            gov.discharge(st.charged)
+            gov.discharge(st.charged, tenant=st.tenant)
             st.charged = 0
         gov.release_conn()
 
@@ -876,17 +878,31 @@ class ExtProcEngine:
     def _on_request_headers(self, st: _ExtStream, payload: dict) -> List[bytes]:
         gov = self.sidecar.governor
         st.headers = payload.get("headers", [])
+        if self.sidecar.config.trust_tenant_header:
+            for key, value in st.headers:
+                if key.lower() == TENANT_HEADER:
+                    st.tenant = value or None
+                    break
         head_bytes = sum(len(k) + len(v) for k, v in st.headers)
         if not gov.can_admit(head_bytes):
             gov.count("shed_total")
             st.done = True
             return [self._shed_response()]
-        gov.charge(head_bytes)
+        if st.tenant is not None and gov.tenant_over_share(st.tenant, head_bytes):
+            gov.count("shed_total")
+            gov.count_tenant_shed(st.tenant)
+            st.done = True
+            return [self._shed_response(tenant=st.tenant)]
+        gov.charge(head_bytes, tenant=st.tenant)
         st.charged += head_bytes
         self.frontend.bytes_total += head_bytes
         if payload.get("end_of_stream"):
             st.deadline = None
-            return [self._evaluate(st, _PRESP_REQUEST_HEADERS)]
+            # Headers-only request: the interactive lane answers it ahead
+            # of any buffered-body traffic queued on the bulk lane.
+            return [self._evaluate(
+                st, _PRESP_REQUEST_HEADERS, lane=LANE_INTERACTIVE
+            )]
         # Body follows (BUFFERED): answer the header phase with a bare
         # CONTINUE and hold the verdict for the body message.
         st.await_body = True
@@ -913,23 +929,31 @@ class ExtProcEngine:
                 gov.count("shed_total")
                 st.done = True
                 return [self._shed_response()]
-            gov.charge(len(chunk))
+            if st.tenant is not None and gov.tenant_over_share(
+                st.tenant, len(chunk)
+            ):
+                gov.count("shed_total")
+                gov.count_tenant_shed(st.tenant)
+                st.done = True
+                return [self._shed_response(tenant=st.tenant)]
+            gov.charge(len(chunk), tenant=st.tenant)
             st.charged += len(chunk)
             self.frontend.bytes_total += len(chunk)
             st.body += chunk
         if payload.get("end_of_stream", True):
             st.deadline = None
-            return [self._evaluate(st, _PRESP_REQUEST_BODY)]
+            return [self._evaluate(st, _PRESP_REQUEST_BODY, lane=LANE_BULK)]
         return []
 
     # -- evaluation → ProcessingResponse ------------------------------------
 
-    def _shed_response(self) -> bytes:
+    def _shed_response(self, tenant: Optional[str] = None) -> bytes:
         sc = self.sidecar
-        err = Overloaded(
-            "ingress memory budget exceeded",
-            retry_after_s=sc.config.shed_retry_after_s,
+        msg = (
+            f"tenant {tenant!r} over weighted fair share"
+            if tenant is not None else "ingress memory budget exceeded"
         )
+        err = Overloaded(msg, retry_after_s=sc.shed_retry_after())
         status, payload, headers = sc.overloaded_reply(err, as_json=False)
         return self._reply_immediate(
             status, payload,
@@ -942,7 +966,10 @@ class ExtProcEngine:
         self.frontend.immediate_total += 1
         return encode_immediate_response(status, payload, headers)
 
-    def _evaluate(self, st: _ExtStream, phase_field: int) -> bytes:
+    def _evaluate(
+        self, st: _ExtStream, phase_field: int,
+        lane: Optional[str] = None,
+    ) -> bytes:
         """The ext_proc analogue of the threaded ``_handle_filter``: one
         ``filter_reply`` call, the same trace events, the same header
         bytes — encoded as CONTINUE+mutation (allow/fail-open) or an
@@ -1000,7 +1027,7 @@ class ExtProcEngine:
             ctx.event("accept", t_accept, t_accept, track="frontend")
             ctx.event("parse", t_accept, _time.monotonic(), track="frontend")
         status, payload, headers = sc.filter_reply(
-            req, tenant=tenant, deadline_s=deadline_s, span=ctx
+            req, tenant=tenant, deadline_s=deadline_s, span=ctx, lane=lane
         )
         if ctx is not None:
             headers = {**(headers or {}), "traceparent": ctx.response_traceparent()}

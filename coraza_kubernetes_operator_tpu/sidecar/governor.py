@@ -29,6 +29,11 @@ DEFAULT_BODY_TIMEOUT_S = 30.0
 DEFAULT_WRITE_TIMEOUT_S = 20.0
 DEFAULT_MAX_BODY_BYTES = 10 * 1024 * 1024
 DEFAULT_MEMORY_BUDGET_BYTES = 256 * 1024 * 1024
+# Tenant-scoped shedding arms once the global ledger is this full: below
+# it there is headroom for everyone and weighted fairness costs nothing;
+# above it the noisiest tenant must 429 BEFORE the global budget trips
+# and innocents feel it.
+DEFAULT_TENANT_SHED_FRACTION = 0.5
 
 
 class BodyTooLarge(Exception):
@@ -41,6 +46,38 @@ class BadContentLength(Exception):
 
 class MemoryShed(Exception):
     """Admitting this request would blow the in-flight byte budget → 429."""
+
+
+class TenantShed(MemoryShed):
+    """This tenant is over its weighted fair share while the ledger is
+    under pressure → 429 for the noisy tenant only. Subclasses
+    :class:`MemoryShed` so every existing 429 handler keeps the exact
+    shed taxonomy."""
+
+    def __init__(self, tenant: Optional[str], message: str) -> None:
+        super().__init__(message)
+        self.tenant = tenant
+
+
+def parse_tenant_weights(raw: Optional[str]) -> Dict[str, float]:
+    """Parse ``CKO_TENANT_WEIGHTS`` (``"tenantA=3,tenantB=1"``) into a
+    weight table. Unknown/absent tenants weigh 1.0; malformed entries
+    are dropped (a broken knob must never take the listener down)."""
+    weights: Dict[str, float] = {}
+    if not raw:
+        return weights
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        try:
+            w = float(val)
+        except ValueError:
+            continue
+        if w > 0 and name.strip():
+            weights[name.strip()] = w
+    return weights
 
 
 def _env_float(name: str, default: float) -> float:
@@ -88,6 +125,7 @@ class IngressGovernor:
         write_timeout_s: Optional[float] = None,
         max_body_bytes: Optional[int] = None,
         memory_budget_bytes: Optional[int] = None,
+        tenant_weights: Optional[str] = None,
     ) -> None:
         self.max_connections = _pick_i(
             max_connections, "CKO_INGRESS_MAX_CONNS", DEFAULT_MAX_CONNECTIONS
@@ -113,9 +151,25 @@ class IngressGovernor:
             DEFAULT_MEMORY_BUDGET_BYTES,
         )
 
+        # Per-tenant weighted-fair admission (ISSUE 16): configured
+        # weights (default equal), the per-tenant slice of the in-flight
+        # ledger, and tenant-scoped shed counters. config field →
+        # CKO_TENANT_WEIGHTS → empty (every tenant weighs 1.0).
+        if tenant_weights is None:
+            tenant_weights = os.environ.get("CKO_TENANT_WEIGHTS", "")
+        self.tenant_weights: Dict[str, float] = parse_tenant_weights(
+            tenant_weights
+        )
+        self.tenant_shed_fraction = _env_float(
+            "CKO_TENANT_SHED_FRACTION", DEFAULT_TENANT_SHED_FRACTION
+        )
+
         self._lock = threading.Lock()
         self._conns = 0
         self._inflight_bytes = 0
+        self._tenant_bytes: Dict[Optional[str], int] = {}
+        self._tenant_reqs: Dict[Optional[str], int] = {}
+        self.tenant_sheds: Dict[Optional[str], int] = {}
 
         # cko_ingress_* counters; read by the metrics registry + stats().
         self.conns_rejected_total = 0
@@ -151,19 +205,101 @@ class IngressGovernor:
         with self._lock:
             return self._inflight_bytes + nbytes <= self.memory_budget_bytes
 
-    def charge(self, nbytes: int) -> None:
+    def charge(self, nbytes: int, tenant: Optional[str] = None) -> None:
         if nbytes <= 0:
             return
         with self._lock:
             self._inflight_bytes += nbytes
+            if tenant is not None:
+                self._tenant_bytes[tenant] = (
+                    self._tenant_bytes.get(tenant, 0) + nbytes
+                )
+                self._tenant_reqs[tenant] = self._tenant_reqs.get(tenant, 0) + 1
 
-    def discharge(self, nbytes: int) -> None:
+    def discharge(self, nbytes: int, tenant: Optional[str] = None) -> None:
         if nbytes <= 0:
             return
         with self._lock:
             self._inflight_bytes -= nbytes
             if self._inflight_bytes < 0:  # defensive: never go negative
                 self._inflight_bytes = 0
+            if tenant is not None:
+                left = self._tenant_bytes.get(tenant, 0) - nbytes
+                reqs = self._tenant_reqs.get(tenant, 0) - 1
+                if left > 0:
+                    self._tenant_bytes[tenant] = left
+                else:
+                    self._tenant_bytes.pop(tenant, None)
+                if reqs > 0:
+                    self._tenant_reqs[tenant] = reqs
+                else:
+                    self._tenant_reqs.pop(tenant, None)
+
+    # -- per-tenant weighted fairness (ISSUE 16) --------------------------
+
+    def weight_for(self, tenant: Optional[str]) -> float:
+        """Configured admission weight for one tenant (default 1.0; the
+        default tenant may be keyed as ``default``)."""
+        if tenant is None:
+            return self.tenant_weights.get("default", 1.0)
+        return self.tenant_weights.get(tenant, 1.0)
+
+    def tenant_fair_share_bytes(self, tenant: Optional[str]) -> int:
+        """This tenant's weighted slice of the memory budget, computed
+        over the tenants CURRENTLY holding in-flight bytes (an idle
+        fleet never dilutes the active ones)."""
+        if self.memory_budget_bytes < 0:
+            return -1
+        with self._lock:
+            active = set(self._tenant_bytes)
+        active.add(tenant)
+        total_w = sum(self.weight_for(t) for t in active)
+        if total_w <= 0:
+            return self.memory_budget_bytes
+        return int(
+            self.memory_budget_bytes * self.weight_for(tenant) / total_w
+        )
+
+    def tenant_over_share(self, tenant: Optional[str], nbytes: int) -> bool:
+        """True when admitting ``nbytes`` for ``tenant`` should shed
+        TENANT-scoped: the global ledger is under pressure (past
+        ``tenant_shed_fraction`` of budget) AND this tenant would exceed
+        its weighted fair share. Below the pressure line everyone rides
+        the global budget alone — fairness only bites when scarcity is
+        real, so a lone tenant can still use the whole budget."""
+        if self.memory_budget_bytes < 0 or tenant is None:
+            return False
+        with self._lock:
+            global_now = self._inflight_bytes
+            tenant_now = self._tenant_bytes.get(tenant, 0)
+        if global_now + nbytes <= (
+            self.memory_budget_bytes * self.tenant_shed_fraction
+        ):
+            return False
+        return tenant_now + nbytes > self.tenant_fair_share_bytes(tenant)
+
+    def count_tenant_shed(self, tenant: Optional[str]) -> None:
+        with self._lock:
+            self.tenant_sheds[tenant] = self.tenant_sheds.get(tenant, 0) + 1
+
+    def tenant_ledger(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant snapshot for stats()/metrics (None keyed as
+        ``default``)."""
+        with self._lock:
+            tenants = (
+                set(self._tenant_bytes)
+                | set(self._tenant_reqs)
+                | set(self.tenant_sheds)
+            )
+            return {
+                (t if t is not None else "default"): {
+                    "inflight_bytes": self._tenant_bytes.get(t, 0),
+                    "inflight_requests": self._tenant_reqs.get(t, 0),
+                    "shed_total": self.tenant_sheds.get(t, 0),
+                    "weight": self.weight_for(t),
+                }
+                for t in tenants
+            }
 
     # -- counters ---------------------------------------------------------
 
@@ -200,4 +336,6 @@ class IngressGovernor:
                 "slow_disconnects_total": self.slow_disconnects_total,
                 "conn_errors_total": self.conn_errors_total,
                 "aborted_total": self.aborted_total,
+                "tenant_shed_fraction": self.tenant_shed_fraction,
+                "tenant_weights": dict(self.tenant_weights),
             }

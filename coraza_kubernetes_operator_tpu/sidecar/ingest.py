@@ -58,7 +58,7 @@ from http.client import responses as _REASONS
 
 from ..engine.request import HttpRequest
 from ..utils import get_logger
-from .batcher import EngineUnavailable
+from .batcher import LANE_BULK, LANE_INTERACTIVE, LANES, EngineUnavailable
 from .degraded import BreakerOpen, Overloaded
 
 log = get_logger("sidecar.ingest")
@@ -330,16 +330,23 @@ class AsyncIngestFrontend:
         self._ctl_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="cko-ingest-ctl"
         )
-        # Window under assembly. Loop-thread only — no locks anywhere on
-        # the hot path.
-        self._win_buf = bytearray()
-        self._win_futs: list[asyncio.Future] = []
+        # Windows under assembly, one per priority lane (ISSUE 16):
+        # headers-only requests accumulate in the interactive window,
+        # bodied ones in the bulk window, so a bodied flood never rides
+        # (or delays) a headers-only window. Loop-thread only — no locks
+        # anywhere on the hot path.
+        self._win_buf = {lane: bytearray() for lane in LANES}
+        self._win_futs: dict[str, list[asyncio.Future]] = {
+            lane: [] for lane in LANES
+        }
         # Flight-recorder contexts aligned with _win_futs. Lazily
         # materialized: None until some request in the window is traced,
         # so the sampling-off hot path never touches it.
-        self._win_traces: list | None = None
+        self._win_traces: dict[str, list | None] = {lane: None for lane in LANES}
         self._tracer = sidecar.tracer
-        self._win_timer: asyncio.TimerHandle | None = None
+        self._win_timer: dict[str, asyncio.TimerHandle | None] = {
+            lane: None for lane in LANES
+        }
         self._inflight_windows = 0
         # Counters (written on the loop thread; racy cross-thread reads
         # are fine for metrics).
@@ -351,6 +358,7 @@ class AsyncIngestFrontend:
         self.parse_s = 0.0
         self.windows_total = 0
         self.window_requests_total = 0
+        self.lane_windows_total = {lane: 0 for lane in LANES}
         self.python_path_requests_total = 0
         self._render_cache: dict = {}
 
@@ -644,7 +652,20 @@ class AsyncIngestFrontend:
                             body = terr.partial
                             close_after = True
             nbytes = len(head) + len(body)
-            gov.charge(nbytes)
+            # Per-tenant weighted-fair admission (ISSUE 16): the byte
+            # ledger is sliced per tenant, and under memory pressure the
+            # tenant over its weighted share sheds BEFORE the global
+            # budget trips for everyone else.
+            tenant = None
+            if not is_ctl and self.sidecar.config.trust_tenant_header:
+                t = special.get(b"x-waf-tenant")
+                tenant = t.decode("latin-1", "replace") if t else None
+            if tenant is not None and gov.tenant_over_share(tenant, nbytes):
+                gov.count("shed_total")
+                gov.count_tenant_shed(tenant)
+                self._put_shed(queue, tenant=tenant)
+                return
+            gov.charge(nbytes, tenant=tenant)
             self.bytes_total += nbytes
             self.requests_total += 1
             conn_tok = special.get(b"connection", b"").lower()
@@ -655,7 +676,7 @@ class AsyncIngestFrontend:
             if close_after:
                 keep_alive = False
             fut = self._route(method, target, version, pairs, special, body, remote_b)
-            queue.put_nowait((fut, keep_alive, nbytes))
+            queue.put_nowait((fut, keep_alive, nbytes, tenant))
             if not keep_alive:
                 return
 
@@ -704,7 +725,7 @@ class AsyncIngestFrontend:
                 item = await queue.get()
                 if item is None:
                     return
-                fut, keep_alive, charge = item
+                fut, keep_alive, charge, tenant = item
                 try:
                     try:
                         status, payload, headers = await fut
@@ -738,7 +759,7 @@ class AsyncIngestFrontend:
                                 pass
                             return
                 finally:
-                    gov.discharge(charge)
+                    gov.discharge(charge, tenant=tenant)
                     sem.release()
                 if not keep_alive:
                     return
@@ -770,20 +791,23 @@ class AsyncIngestFrontend:
     def _put_static(self, queue, status: int, payload: bytes) -> None:
         fut = self._loop.create_future()
         fut.set_result((status, payload, {"Content-Type": "text/plain"}))
-        queue.put_nowait((fut, False, 0))
+        queue.put_nowait((fut, False, 0, None))
 
-    def _put_shed(self, queue) -> None:
+    def _put_shed(self, queue, tenant: str | None = None) -> None:
         """Memory-budget shed: same 429 + Retry-After + x-waf-action
         surface the queue-budget shed uses, so clients back off the same
-        way regardless of which budget tripped."""
+        way regardless of which budget tripped. Retry-After scales with
+        the live backlog (sidecar.shed_retry_after)."""
         sc = self.sidecar
-        err = Overloaded(
-            "ingress memory budget exceeded",
-            retry_after_s=sc.config.shed_retry_after_s,
+        msg = (
+            f"tenant {tenant!r} over weighted fair share"
+            if tenant is not None
+            else "ingress memory budget exceeded"
         )
+        err = Overloaded(msg, retry_after_s=sc.shed_retry_after())
         fut = self._loop.create_future()
         fut.set_result(sc.overloaded_reply(err, as_json=False))
-        queue.put_nowait((fut, False, 0))
+        queue.put_nowait((fut, False, 0, None))
 
     # -- routing -------------------------------------------------------------
 
@@ -828,9 +852,12 @@ class AsyncIngestFrontend:
             )
         # -- hot path: slice the wire bytes straight into the native
         # batch-blob record (native.serialize_requests wire format; zero
-        # HttpRequest materialization).
+        # HttpRequest materialization). Lane split at the same point:
+        # headers-only requests build the interactive window, bodied
+        # ones the bulk window.
         t0 = _time.perf_counter()
-        buf = self._win_buf
+        lane = LANE_BULK if eval_body else LANE_INTERACTIVE
+        buf = self._win_buf[lane]
         buf += _pack("<I", len(method))
         buf += method
         buf += _pack("<I", len(target))
@@ -848,19 +875,24 @@ class AsyncIngestFrontend:
         buf += _pack("<I", len(remote_b))
         buf += remote_b
         fut = self._loop.create_future()
-        self._win_futs.append(fut)
+        futs = self._win_futs[lane]
+        futs.append(fut)
         if ctx is not None:
-            if self._win_traces is None:
-                self._win_traces = [None] * (len(self._win_futs) - 1)
-            self._win_traces.append(ctx)
-        elif self._win_traces is not None:
-            self._win_traces.append(None)
+            if self._win_traces[lane] is None:
+                self._win_traces[lane] = [None] * (len(futs) - 1)
+            self._win_traces[lane].append(ctx)
+        elif self._win_traces[lane] is not None:
+            self._win_traces[lane].append(None)
         self.parse_s += _time.perf_counter() - t0
-        if len(self._win_futs) >= sc.config.max_batch_size:
-            self._flush_window()
-        elif self._win_timer is None:
-            delay = max(sc.config.max_batch_delay_ms, 0.0) / 1e3
-            self._win_timer = self._loop.call_later(delay, self._flush_window)
+        if len(futs) >= sc.config.max_batch_size:
+            self._flush_window(lane)
+        elif self._win_timer[lane] is None:
+            # Live per-lane delay (scheduler-tuned): the interactive
+            # window closes on its own (typically shorter) timer.
+            delay = max(sc.batcher.lane_delay_s[lane], 0.0)
+            self._win_timer[lane] = self._loop.call_later(
+                delay, self._flush_window, lane
+            )
         return fut
 
     def _route_api(self, method, path, special, body, query=""):
@@ -1004,22 +1036,28 @@ class AsyncIngestFrontend:
 
     # -- window assembly + dispatch -------------------------------------------
 
-    def _flush_window(self) -> None:
-        if self._win_timer is not None:
-            self._win_timer.cancel()
-            self._win_timer = None
-        futs = self._win_futs
+    def _flush_window(self, lane: str | None = None) -> None:
+        if lane is None:  # stop()/halt: close out every lane
+            for each in LANES:
+                self._flush_window(each)
+            return
+        timer = self._win_timer[lane]
+        if timer is not None:
+            timer.cancel()
+            self._win_timer[lane] = None
+        futs = self._win_futs[lane]
         if not futs:
             return
-        blob = bytes(self._win_buf)
-        spans = self._win_traces
-        self._win_futs = []
-        self._win_buf = bytearray()
-        self._win_traces = None
+        blob = bytes(self._win_buf[lane])
+        spans = self._win_traces[lane]
+        self._win_futs[lane] = []
+        self._win_buf[lane] = bytearray()
+        self._win_traces[lane] = None
         self.windows_total += 1
         self.window_requests_total += len(futs)
+        self.lane_windows_total[lane] += 1
         try:
-            self._dispatch_window(blob, futs, spans)
+            self._dispatch_window(blob, futs, spans, lane)
         except Exception as err:
             # Dispatch containment: a routing bug answers this window
             # 500 instead of leaving futures (and connections) hanging.
@@ -1029,7 +1067,9 @@ class AsyncIngestFrontend:
                 if not f.done():
                     f.set_result(reply)
 
-    def _dispatch_window(self, blob: bytes, futs: list, spans=None) -> None:
+    def _dispatch_window(
+        self, blob: bytes, futs: list, spans=None, lane: str = LANE_BULK
+    ) -> None:
         """Route one assembled window. Runs on the loop thread — every
         step here is a cheap probe; blocking work goes to the batcher or
         the evaluation pool."""
@@ -1052,13 +1092,13 @@ class AsyncIngestFrontend:
             self._submit_eval(self._fallback_window, engine, blob, futs, spans)
             return
         try:
-            sc._admit_device(len(futs))
+            sc._admit_device(len(futs), lane=lane)
         except Overloaded as err:
             reply = sc.overloaded_reply(err, as_json=False)
             self._answer_all_traced(futs, spans, lambda: reply, "shed", "shed")
             return
         self._inflight_windows += 1
-        wfut = sc.batcher.submit_window(blob, len(futs), spans=spans)
+        wfut = sc.batcher.submit_window(blob, len(futs), spans=spans, lane=lane)
         # Same budget ladder as the threaded bulk path: cold engines get
         # the compile budget; warmed ones the strict timeout plus a
         # bounded recompile grace (fresh-shape tier buckets mid-stream).
@@ -1219,6 +1259,7 @@ class AsyncIngestFrontend:
             "parse_s": round(self.parse_s, 6),
             "windows": self.windows_total,
             "window_requests": self.window_requests_total,
+            "lane_windows": dict(self.lane_windows_total),
             "python_path_requests": self.python_path_requests_total,
             "inflight_windows": self._inflight_windows,
         }

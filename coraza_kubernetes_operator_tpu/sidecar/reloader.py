@@ -196,7 +196,9 @@ class RuleReloader:
         if k <= 0:
             base = self.poll_interval_s
         else:
-            base = min(self.poll_interval_s, BACKOFF_BASE_S * (2 ** (k - 1)))
+            # Cap the exponent: 2**k overflows float conversion once a
+            # long outage pushes k past ~1024, killing the poll thread.
+            base = min(self.poll_interval_s, BACKOFF_BASE_S * 2.0 ** min(k - 1, 60))
         return base * random.uniform(1.0 - JITTER_FRACTION, 1.0 + JITTER_FRACTION)
 
     def _poll_failed(self) -> None:

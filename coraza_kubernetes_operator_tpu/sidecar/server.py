@@ -47,8 +47,12 @@ from ..utils import get_logger
 from .batcher import (
     DEFAULT_MAX_BATCH_DELAY_MS,
     DEFAULT_MAX_BATCH_SIZE,
+    LANE_BULK,
+    LANE_INTERACTIVE,
+    LANES,
     EngineUnavailable,
     MicroBatcher,
+    classify_lane,
 )
 from .degraded import (
     BREAKER_CODES,
@@ -61,7 +65,14 @@ from .degraded import (
     Overloaded,
 )
 from .degraded import is_device_loss
-from .governor import BadContentLength, BodyTooLarge, IngressGovernor, MemoryShed
+from .governor import (
+    BadContentLength,
+    BodyTooLarge,
+    IngressGovernor,
+    MemoryShed,
+    TenantShed,
+)
+from .scheduler import AdaptiveScheduler
 from .quarantine import PoisonBisector, QuarantineRegistry
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .rollout import RolloutConfig, RolloutManager
@@ -298,6 +309,23 @@ class SidecarConfig:
     # dependency-free HTTP/2 subset otherwise; pin with "native" /
     # "grpcio" (or CKO_EXTPROC_IMPL while the field stays "auto").
     extproc_impl: str = "auto"
+    # -- overload isolation (docs/SERVING.md "Priority lanes & fairness") ----
+    # Headers-only/interactive lane micro-batch delay. None reads
+    # CKO_LANE_DELAY_MS; unset keeps it equal to max_batch_delay_ms (the
+    # lanes then differ only in queueing, not window timing). The
+    # resolved value is written back onto this field.
+    lane_delay_ms: float | None = None
+    # Weighted-fair tenant admission table ("tenantA=3,tenantB=1"). None
+    # reads CKO_TENANT_WEIGHTS; unknown tenants weigh 1.0.
+    tenant_weights: str | None = None
+    # Latency SLO the adaptive scheduler steers the batching knobs
+    # toward. None reads CKO_SLO_P99_MS (default 50).
+    slo_p99_ms: float | None = None
+    # Adaptive scheduler (sidecar/scheduler.py) kill switch: False keeps
+    # every knob exactly where the config put it (--disable-adaptive).
+    adaptive_enabled: bool = True
+    # Controller tick period. None reads CKO_SCHED_INTERVAL_S (0.5).
+    sched_interval_s: float | None = None
 
 
 def request_from_json(obj: dict) -> HttpRequest:
@@ -491,6 +519,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         gov = self.sidecar.governor
         path = self.path.split("?", 1)[0]
+        tenant = None
+        if self.sidecar.config.trust_tenant_header and path not in _CONTROL_PATHS:
+            tenant = self.headers.get(TENANT_HEADER) or None
         try:
             body = self._read_body()
         except BadContentLength:
@@ -508,7 +539,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             err = Overloaded(
                 "ingress memory budget exceeded",
-                retry_after_s=self.sidecar.config.shed_retry_after_s,
+                retry_after_s=self.sidecar.shed_retry_after(),
             )
             self._reply(*self.sidecar.overloaded_reply(err, as_json=False))
             return
@@ -525,7 +556,20 @@ class _Handler(BaseHTTPRequestHandler):
         except ConnectionError:
             self.close_connection = True
             return
-        gov.charge(len(body))
+        # Tenant-scoped shed BEFORE the global ledger admits (ISSUE 16):
+        # under memory pressure the tenant over its weighted fair share
+        # 429s while everyone else rides the remaining headroom. Same
+        # taxonomy bytes as the global shed.
+        if gov.tenant_over_share(tenant, len(body)):
+            gov.count_tenant_shed(tenant)
+            self.sidecar.count_shed()
+            err = Overloaded(
+                f"tenant {tenant!r} over weighted fair share",
+                retry_after_s=self.sidecar.shed_retry_after(),
+            )
+            self._reply(*self.sidecar.overloaded_reply(err, as_json=False))
+            return
+        gov.charge(len(body), tenant=tenant)
         try:
             if path == API_PREFIX + "evaluate":
                 self._handle_bulk(body)
@@ -544,7 +588,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._handle_filter(body)
         finally:
-            gov.discharge(len(body))
+            gov.discharge(len(body), tenant=tenant)
 
     do_PUT = do_PATCH = do_DELETE = do_POST  # noqa: N815
 
@@ -660,6 +704,7 @@ class TpuEngineSidecar:
             write_timeout_s=config.write_timeout_s,
             max_body_bytes=config.max_body_bytes,
             memory_budget_bytes=config.ingress_memory_budget_bytes,
+            tenant_weights=config.tenant_weights,
         )
         keys = [k.strip() for k in config.instance_key.split(",") if k.strip()]
         # Staged ruleset rollout (docs/ROLLOUT.md): budgeted background
@@ -695,13 +740,45 @@ class TpuEngineSidecar:
         )
         if engine is not None:  # pre-seeded (tests / static rules)
             self.tenants.seed(self.tenants.default_tenant, engine)
+        # Priority lanes (docs/SERVING.md "Priority lanes & fairness"):
+        # the interactive (headers-only) lane's window delay resolves
+        # config field -> CKO_LANE_DELAY_MS -> None (= same as the bulk
+        # lane's max_batch_delay_ms); the batcher's submit queues apply
+        # the governor's tenant weights via deficit round-robin.
+        if config.lane_delay_ms is None:
+            raw = os.environ.get("CKO_LANE_DELAY_MS", "").strip()
+            if raw:
+                try:
+                    config.lane_delay_ms = float(raw)
+                except ValueError:
+                    config.lane_delay_ms = None
         self.batcher = MicroBatcher(
             engine_fn=lambda tenant: self.tenants.engine_for(tenant),
             max_batch_size=config.max_batch_size,
             max_batch_delay_ms=config.max_batch_delay_ms,
             phase_split=config.phase_split,
             pipeline_depth=config.pipeline_depth,
+            lane_delay_ms=config.lane_delay_ms,
+            weight_fn=self.governor.weight_for,
         )
+        config.lane_delay_ms = self.batcher.lane_delay_s[LANE_INTERACTIVE] * 1e3
+        # Per-lane queue budgets for admission control. Shared BY
+        # REFERENCE with the adaptive scheduler, which retunes them
+        # between their base value and base/8 under SLO pressure.
+        self.lane_queue_budgets: dict[str, int] = {
+            lane: config.queue_budget for lane in LANES
+        }
+        self.scheduler: AdaptiveScheduler | None = None
+        if config.adaptive_enabled:
+            self.scheduler = AdaptiveScheduler(
+                self.batcher,
+                slo_p99_ms=config.slo_p99_ms,
+                interval_s=config.sched_interval_s,
+                queue_budgets=self.lane_queue_budgets,
+                on_retune=self._on_retune,
+            )
+            config.slo_p99_ms = self.scheduler.slo_p99_ms
+            config.sched_interval_s = self.scheduler.interval_s
         if self.rollout is not None:
             # Mirror collected windows into any shadowing candidate
             # (cheap dict probe when no rollout is active).
@@ -742,6 +819,113 @@ class TpuEngineSidecar:
             "Device stage per window group (readback block + decode)",
         )
         self.batcher.stats.on_stage = self._on_stage
+        # -- priority lanes + fair admission (docs/SERVING.md) --------------
+        m_lane_pending = self.metrics.gauge(
+            "cko_lane_pending",
+            "Requests queued in the lane's submit queue",
+            ("lane",),
+        )
+        m_lane_delay = self.metrics.gauge(
+            "cko_lane_delay_ms",
+            "Live micro-batch window delay per lane (scheduler-tuned)",
+            ("lane",),
+        )
+        m_lane_budget = self.metrics.gauge(
+            "cko_lane_queue_budget",
+            "Live queue-admission budget per lane (scheduler-tuned)",
+            ("lane",),
+        )
+        m_lane_windows = self.metrics.gauge(
+            "cko_lane_windows_total",
+            "Batch windows dispatched per lane",
+            ("lane",),
+        )
+        m_lane_requests = self.metrics.gauge(
+            "cko_lane_requests_total",
+            "Requests dispatched to device per lane",
+            ("lane",),
+        )
+        for lane in LANES:
+            m_lane_pending.set_function(
+                (lambda l: lambda: float(self.batcher.pending(l)))(lane),
+                lane=lane,
+            )
+            m_lane_delay.set_function(
+                (lambda l: lambda: float(self.batcher.lane_delay_s[l] * 1e3))(lane),
+                lane=lane,
+            )
+            m_lane_budget.set_function(
+                (lambda l: lambda: float(self.lane_queue_budgets[l]))(lane),
+                lane=lane,
+            )
+            m_lane_windows.set_function(
+                (lambda l: lambda: float(self.batcher.lane_windows[l]))(lane),
+                lane=lane,
+            )
+            m_lane_requests.set_function(
+                (lambda l: lambda: float(self.batcher.lane_requests[l]))(lane),
+                lane=lane,
+            )
+        self._m_lane_shed = self.metrics.counter(
+            "cko_lane_shed_total",
+            "Requests shed by per-lane queue admission (429)",
+            ("lane",),
+        )
+        # Per-tenant gauges: the label set grows as tenants appear, so
+        # values refresh from the governor ledger at render time (same
+        # idiom as cko_compile_tier_s).
+        self._m_tenant_bytes = self.metrics.gauge(
+            "cko_tenant_inflight_bytes",
+            "In-flight request bytes held per tenant",
+            ("tenant",),
+        )
+        self._m_tenant_reqs = self.metrics.gauge(
+            "cko_tenant_inflight_requests",
+            "In-flight requests charged per tenant",
+            ("tenant",),
+        )
+        self._m_tenant_shed = self.metrics.gauge(
+            "cko_tenant_shed_total",
+            "Tenant-scoped fair-share sheds (429) per tenant",
+            ("tenant",),
+        )
+        self._m_tenant_weight = self.metrics.gauge(
+            "cko_tenant_weight",
+            "Configured admission weight per active tenant",
+            ("tenant",),
+        )
+        # -- adaptive scheduler (docs/SERVING.md) ---------------------------
+        self.metrics.gauge(
+            "cko_sched_enabled",
+            "1 when the adaptive scheduler thread is tuning knobs",
+        ).set_function(
+            lambda: 1.0 if self.scheduler is not None and self.scheduler.enabled else 0.0
+        )
+        self.metrics.gauge(
+            "cko_sched_p99_ms",
+            "Step-latency p99 last observed by the scheduler",
+        ).set_function(
+            lambda: float(self.scheduler.last_p99_ms) if self.scheduler else 0.0
+        )
+        self.metrics.gauge(
+            "cko_sched_slo_ms",
+            "Configured latency SLO the scheduler steers toward",
+        ).set_function(
+            lambda: float(self.scheduler.slo_p99_ms) if self.scheduler else 0.0
+        )
+        self.metrics.gauge(
+            "cko_sched_occupancy",
+            "Queue occupancy (pending / budgets) last observed by the scheduler",
+        ).set_function(
+            lambda: float(self.scheduler.last_occupancy) if self.scheduler else 0.0
+        )
+        # Per-knob retune counts refresh at render time — knob labels
+        # only exist once the scheduler moved that knob.
+        self._m_sched_retunes = self.metrics.gauge(
+            "cko_sched_retunes_total",
+            "Knob retunes applied by the adaptive scheduler, per knob",
+            ("knob",),
+        )
         self._m_ready = self.metrics.gauge(
             "waf_ready", "1 when a compiled ruleset is loaded"
         )
@@ -1219,6 +1403,47 @@ class TpuEngineSidecar:
         self._m_batch_size.observe(size)
         self._m_step.observe(latency_s, exemplar=trace_id)
 
+    def _on_retune(self, event: dict) -> None:
+        """Adaptive-scheduler observability fanout: the structured log
+        line always fires; when trace sampling is on, each retune also
+        commits its own flight record (path tag ``sched``, one
+        ``sched_retune`` event carrying the knob deltas) so knob moves
+        line up with request spans on the same timeline."""
+        log.info(
+            "scheduler retune",
+            direction=event["direction"],
+            p99_ms=event["p99_ms"],
+            occupancy=event["occupancy"],
+            changes=event["changes"],
+        )
+        if self.tracer.sample_rate <= 0.0:
+            return
+        try:
+            from ..observability.tracing import (
+                SpanContext,
+                new_span_id,
+                new_trace_id,
+            )
+
+            ctx = SpanContext(new_trace_id(), new_span_id(), None, 1, True)
+            ctx.annotate_path("sched")
+            now = _time.monotonic()
+            ctx.event(
+                "sched_retune",
+                now,
+                now,
+                track="scheduler",
+                args={
+                    "direction": event["direction"],
+                    "p99_ms": event["p99_ms"],
+                    "occupancy": event["occupancy"],
+                    "changes": event["changes"],
+                },
+            )
+            self.tracer.commit(ctx)
+        except Exception:  # observability must never take the controller down
+            pass
+
     def _on_stage(
         self, host_s: float, device_s: float, trace_id: str | None = None
     ) -> None:
@@ -1407,6 +1632,11 @@ class TpuEngineSidecar:
 
     def count_failopen(self, n: int = 1) -> None:
         self._m_failopen.inc(n)
+
+    def count_shed(self, n: int = 1, lane: str | None = None) -> None:
+        self._m_shed.inc(n)
+        if lane is not None:
+            self._m_lane_shed.inc(n, lane=lane)
 
     # -- frontend-shared reply builders ---------------------------------------
     # Both frontends (threaded _Handler and the async ingest loop) answer
@@ -1662,13 +1892,18 @@ class TpuEngineSidecar:
         tenant: str | None = None,
         deadline_s: float | None = None,
         span=None,
+        lane: str | None = None,
     ) -> tuple[int, bytes, dict]:
         """Filter mode, end to end: evaluate the inbound request and map
         the verdict (or degraded-mode exception) to the wire reply.
         ``span`` is an optional flight-recorder context; degraded exits
-        tag it so an exported trace names the branch taken."""
+        tag it so an exported trace names the branch taken. ``lane``
+        pins the priority lane (ext_proc classifies at the protocol
+        level); None auto-classifies from the request body."""
         try:
-            verdict = self.evaluate(req, tenant=tenant, deadline_s=deadline_s, span=span)
+            verdict = self.evaluate(
+                req, tenant=tenant, deadline_s=deadline_s, span=span, lane=lane
+            )
         except Overloaded as err:
             self._span_degraded(span, "shed", "shed")
             return self.overloaded_reply(err, as_json=False)
@@ -1779,20 +2014,79 @@ class TpuEngineSidecar:
             503, {"error": "WAF unavailable (fail-closed: circuit breaker open)"}
         )
 
-    def _admit_device(self, n: int = 1) -> None:
+    def shed_retry_after(self, lane: str | None = None) -> float:
+        """Live ``Retry-After`` for shed replies: the configured base
+        scaled by how deep the (lane's) backlog sits relative to its
+        queue budget, capped at 8x — a client that backs off proportional
+        to the actual queue drains it instead of stampeding at a fixed
+        interval. Never raises; falls back to the configured constant."""
+        base = self.config.shed_retry_after_s
+        try:
+            if lane is None:
+                budget = self.config.queue_budget
+            else:
+                budget = self.lane_queue_budgets.get(lane, self.config.queue_budget)
+            if budget is None or budget <= 0:
+                return base
+            pending = self.batcher.pending(lane)
+            return base * min(8.0, max(1.0, pending / budget))
+        except Exception:
+            return base
+
+    def _tenant_queue_over_share(
+        self, tenant: str | None, n: int, pending: int, budget: int
+    ) -> bool:
+        """Tenant-scoped queue admission (the batch-assembly mirror of
+        the governor's byte-ledger fairness): once the lane backlog
+        passes the pressure fraction, a tenant whose queued items exceed
+        its weighted share of the budget sheds before the global budget
+        trips for everyone."""
+        if tenant is None or budget <= 0:
+            return False
+        gov = self.governor
+        if pending + n <= budget * gov.tenant_shed_fraction:
+            return False
+        backlog = self.batcher.tenant_backlog()
+        active = set(backlog)
+        active.add(tenant)
+        total_w = sum(gov.weight_for(t) for t in active)
+        if total_w <= 0:
+            return False
+        share = budget * gov.weight_for(tenant) / total_w
+        return backlog.get(tenant, 0) + n > share
+
+    def _admit_device(
+        self, n: int = 1, lane: str | None = None, tenant: str | None = None
+    ) -> None:
         """Queue admission control: shed (429) instead of growing an
         unbounded batcher backlog. ``n`` is how many requests the caller
         is about to submit (a whole ingest window sheds as one unit, but
-        the cko_shed_total counter stays per-request)."""
-        budget = self.config.queue_budget
+        the cko_shed_total counter stays per-request). With a ``lane``
+        the lane's own (scheduler-tuned) budget applies against the
+        lane's own backlog — a bodied flood saturating the bulk lane
+        never sheds headers-only traffic. A known ``tenant`` over its
+        weighted share of the queue sheds first."""
+        if lane is None:
+            budget = self.config.queue_budget
+        else:
+            budget = self.lane_queue_budgets.get(lane, self.config.queue_budget)
         if budget is None or budget < 0:
             return
-        pending = self.batcher.pending()
+        pending = self.batcher.pending(lane)
+        if tenant is not None and self._tenant_queue_over_share(
+            tenant, n, pending, budget
+        ):
+            self.governor.count_tenant_shed(tenant)
+            self.count_shed(n, lane=lane)
+            raise Overloaded(
+                f"tenant {tenant!r} over weighted queue share",
+                retry_after_s=self.shed_retry_after(lane),
+            )
         if pending > budget:
-            self._m_shed.inc(n)
+            self.count_shed(n, lane=lane)
             raise Overloaded(
                 f"batcher backlog {pending} over budget {budget}",
-                retry_after_s=self.config.shed_retry_after_s,
+                retry_after_s=self.shed_retry_after(lane),
             )
 
     def _fallback_eval(
@@ -1806,7 +2100,7 @@ class TpuEngineSidecar:
                 self._m_shed.inc()
                 raise Overloaded(
                     f"host fallback at concurrency budget {budget}",
-                    retry_after_s=self.config.shed_retry_after_s,
+                    retry_after_s=self.shed_retry_after(),
                 )
             self._fallback_inflight += 1
         try:
@@ -1833,17 +2127,20 @@ class TpuEngineSidecar:
         tenant: str | None = None,
         deadline_s: float | None = None,
         span=None,
+        lane: str | None = None,
     ) -> Verdict:
         engine = self.tenants.engine_for(tenant)
         if engine is None:
             raise EngineUnavailable(f"no compiled ruleset loaded for {tenant!r}")
         if self.degraded.route(engine) == "fallback":
             return self._fallback_eval(engine, [request], span=span)[0]
-        self._admit_device()
+        if lane is None:
+            lane = classify_lane(request)
+        self._admit_device(lane=lane, tenant=tenant)
         timeout = self._timeout_for([engine])
         if deadline_s is not None:
             timeout = max(0.001, min(timeout, deadline_s - _time.monotonic()))
-        fut = self.batcher.submit(request, tenant=tenant, span=span)
+        fut = self.batcher.submit(request, tenant=tenant, span=span, lane=lane)
         try:
             return fut.result(timeout=timeout)
         except EngineUnavailable:
@@ -2117,9 +2414,19 @@ class TpuEngineSidecar:
     def render_metrics(self) -> str:
         """Render /metrics, refreshing the per-tier compile-time gauge
         first (its label set grows as tier executables mint — labels
-        cannot be registered up front)."""
+        cannot be registered up front). Per-tenant fairness gauges and
+        per-knob retune counts refresh the same way: their label sets
+        grow with traffic."""
         for label, secs in _tier_compile_stats().items():
             self._m_tier_s.set(secs, tier=label)
+        for tenant, row in self.governor.tenant_ledger().items():
+            self._m_tenant_bytes.set(float(row["inflight_bytes"]), tenant=tenant)
+            self._m_tenant_reqs.set(float(row["inflight_requests"]), tenant=tenant)
+            self._m_tenant_shed.set(float(row["shed_total"]), tenant=tenant)
+            self._m_tenant_weight.set(float(row["weight"]), tenant=tenant)
+        if self.scheduler is not None:
+            for knob, count in self.scheduler.retunes_total.items():
+                self._m_sched_retunes.set(float(count), knob=knob)
         return self.metrics.render()
 
     def stats(self) -> dict:
@@ -2129,6 +2436,21 @@ class TpuEngineSidecar:
                 "depth": self.batcher.pipeline_depth,
                 "inflight_windows": self.batcher.inflight_windows(),
             },
+            "lanes": {
+                lane: {
+                    "pending": self.batcher.pending(lane),
+                    "delay_ms": round(self.batcher.lane_delay_s[lane] * 1e3, 4),
+                    "queue_budget": self.lane_queue_budgets[lane],
+                    "windows_total": self.batcher.lane_windows[lane],
+                    "requests_total": self.batcher.lane_requests[lane],
+                }
+                for lane in LANES
+            },
+            "scheduler": (
+                self.scheduler.stats()
+                if self.scheduler is not None
+                else {"enabled": False}
+            ),
             "watchdog": {
                 "window_deadline_s": self.config.window_deadline_s,
                 "effective_deadline_s": self._effective_deadline(),
@@ -2186,6 +2508,7 @@ class TpuEngineSidecar:
             "ingress": {
                 **self.governor.stats(),
                 "window_bytes_pending": self.batcher.pending_bytes(),
+                "tenants": self.governor.tenant_ledger(),
             },
             "recovery": {
                 "process_start_time": self._start_time,
@@ -2210,6 +2533,8 @@ class TpuEngineSidecar:
 
     def start(self) -> None:
         self.batcher.start()
+        if self.scheduler is not None:
+            self.scheduler.start()
         if self.state_store.enabled:
             # Warm restart: restore from the snapshot BEFORE the first
             # cache poll, off the startup path — the HTTP listener (and
@@ -2274,6 +2599,8 @@ class TpuEngineSidecar:
         self.bisector.stop()
         if self.rollout is not None:
             self.rollout.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()
         self.batcher.stop()
         self.tenants.stop()
         self._persist_state()

@@ -49,6 +49,15 @@ the fault-injection harness (``testing/faults.py``) end to end:
    (``cko_windows_abandoned_total``), the breaker NEVER opens, serving
    stays ``promoted`` for >= 90% of the run, and
    ``POST /waf/v1/quarantine/flush`` drains the registry.
+10. **bodied flood + weighted-fair admission** (ISSUE 16) — a fresh
+    sidecar with ``trust_tenant_header`` and skewed tenant weights
+    (``gold=3,noisy=1``) takes a multi-KB bodied flood from the noisy
+    tenant alongside a well-behaved gold tenant and a concurrent
+    headers-only stream: the interactive lane keeps headers-only p99
+    bounded relative to a quiet baseline, the noisy tenant is shed
+    FIRST (its ``tenant_sheds`` ledger grows while gold's stays zero),
+    every answered request carries the correct verdict, and the
+    governor's byte/connection ledgers drain to zero at the end.
 
 Throughout, a background traffic storm asserts every response is a real
 verdict (200/403, correct per request) — never a blank 500 — and at the
@@ -102,9 +111,10 @@ def _fail(stage: str, **detail) -> int:
     return 1
 
 
-def _http(port, path, timeout=30, method="GET", data=None):
+def _http(port, path, timeout=30, method="GET", data=None, headers=None):
     req = urllib.request.Request(
-        f"http://127.0.0.1:{port}{path}", method=method, data=data
+        f"http://127.0.0.1:{port}{path}", method=method, data=data,
+        headers=headers or {},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -164,6 +174,7 @@ def main() -> int:
     )
     sc.start()
     sc2 = None
+    sc3 = None
 
     stop = threading.Event()
     bad: list = []
@@ -607,6 +618,168 @@ def main() -> int:
             "hang_answer_s": round(hang_answer_s, 3) if hang_answer_s else None,
         }
 
+        # 10. Bodied flood + weighted-fair admission (ISSUE 16): a fresh
+        # sidecar trusts the tenant header and weighs gold 3x over noisy.
+        # A noisy-tenant flood of multi-KB bodies rides the BULK lane
+        # while a concurrent headers-only stream rides the INTERACTIVE
+        # lane: headers-only latency stays bounded relative to a quiet
+        # baseline, the noisy tenant is shed first (tenant ledger), every
+        # answered request is the correct verdict, and the governor's
+        # byte/connection ledgers drain to zero.
+        # Scenario 7 left the rules cache "down" (scenarios 8-9 serve
+        # from the restored snapshot); bring it back — the flood sidecar
+        # must poll three tenant keys from a live cache to promote.
+        os.environ["CKO_FAULT_CACHE_OUTAGE"] = "0"
+        cache.put("noisy", BASE + EVIL_MONKEY)
+        cache.put("gold", BASE + EVIL_MONKEY)
+        sc3 = TpuEngineSidecar(
+            SidecarConfig(
+                host="127.0.0.1",
+                port=0,
+                cache_base_url=f"http://127.0.0.1:{srv.port}",
+                instance_key=KEY + ",noisy,gold",
+                poll_interval_s=0.5,
+                trust_tenant_header=True,
+                tenant_weights="gold=3,noisy=1",
+                ingress_memory_budget_bytes=512 * 1024,
+                queue_budget=256,
+            )
+        )
+        sc3.start()
+        if not _wait(lambda: sc3.serving_mode() == "promoted", 180):
+            return _fail("bodied_flood", detail="flood sidecar never promoted")
+
+        def _p99(samples):
+            xs = sorted(samples)
+            return xs[min(len(xs) - 1, int(round(0.99 * (len(xs) - 1))))]
+
+        def _headers_only(i):
+            attack = i % 2 == 0
+            path = f"/?pet=evilmonkey&f={i}" if attack else f"/?q=fine&f={i}"
+            t0 = time.monotonic()
+            status, body = _http(sc3.port, path)
+            dt = time.monotonic() - t0
+            want = 403 if attack else 200
+            return dt, (None if status == want and body else (path, status))
+
+        # Quiet baseline, then one of each bodied shape so tier selection
+        # is warm before the clock starts.
+        base_lat = []
+        for i in range(60):
+            dt, wrong = _headers_only(i)
+            base_lat.append(dt)
+            if wrong:
+                return _fail("bodied_flood", detail="baseline verdict", got=wrong)
+        noisy_body = b"q=fine&pad=" + b"x" * (96 * 1024)
+        gold_body = b"q=fine&pad=" + b"x" * 2048
+        _http(sc3.port, "/warm", method="POST", data=noisy_body,
+              headers={"X-Waf-Tenant": "noisy"})
+        _http(sc3.port, "/warm", method="POST", data=gold_body,
+              headers={"X-Waf-Tenant": "gold"})
+
+        flood_stop = threading.Event()
+        flood_bad: list = []
+
+        def _bodied(tenant, body):
+            j = 0
+            while not flood_stop.is_set():
+                try:
+                    status, _ = _http(
+                        sc3.port, f"/?t={tenant}&j={j}", method="POST",
+                        data=body, headers={"X-Waf-Tenant": tenant},
+                    )
+                except Exception as err:
+                    flood_bad.append((tenant, j, f"{type(err).__name__}: {err}"))
+                    j += 1
+                    continue
+                # Clean body: a real verdict (200) or a shed (429) — never
+                # a blank 500 and never a spurious block.
+                if status not in (200, 429):
+                    flood_bad.append((tenant, j, status))
+                j += 1
+
+        flooders = [
+            threading.Thread(target=_bodied, args=("noisy", noisy_body),
+                             daemon=True)
+            for _ in range(5)
+        ] + [
+            threading.Thread(target=_bodied, args=("gold", gold_body),
+                             daemon=True)
+            for _ in range(2)
+        ]
+        for t in flooders:
+            t.start()
+        flood_lat = []
+        t_flood = time.monotonic()
+        i = 0
+        try:
+            while time.monotonic() - t_flood < 10:
+                dt, wrong = _headers_only(i)
+                flood_lat.append(dt)
+                if wrong:
+                    flood_bad.append(("headers",) + wrong)
+                i += 1
+        finally:
+            flood_stop.set()
+            for t in flooders:
+                t.join(timeout=60)
+        if flood_bad:
+            return _fail(
+                "bodied_flood", bad=flood_bad[:5], total=len(flood_bad)
+            )
+        ledger = sc3.governor.tenant_ledger()
+        noisy_sheds = ledger.get("noisy", {}).get("shed_total", 0)
+        gold_sheds = ledger.get("gold", {}).get("shed_total", 0)
+        if noisy_sheds < 1:
+            return _fail(
+                "bodied_flood", detail="noisy tenant never shed",
+                ledger=ledger,
+            )
+        if gold_sheds:
+            return _fail(
+                "bodied_flood", detail="well-behaved tenant was shed",
+                ledger=ledger,
+            )
+        lanes = sc3.stats()["lanes"]
+        if not lanes["interactive"]["windows_total"]:
+            return _fail("bodied_flood", detail="interactive lane unused")
+        if not lanes["bulk"]["windows_total"]:
+            return _fail("bodied_flood", detail="bulk lane unused")
+        base_p99, flood_p99 = _p99(base_lat), _p99(flood_lat)
+        # Generous on a 1-core CPU runner: the bound catches starvation
+        # (bulk flood queued ahead of headers-only), not scheduler jitter.
+        p99_ceiling = max(50 * base_p99, 5.0)
+        if flood_p99 > p99_ceiling:
+            return _fail(
+                "bodied_flood", detail="headers-only p99 unbounded",
+                base_p99_s=round(base_p99, 4),
+                flood_p99_s=round(flood_p99, 4),
+            )
+        if not _wait(
+            lambda: sc3.governor.stats()["inflight_bytes"] == 0, 30
+        ):
+            return _fail(
+                "bodied_flood", detail="byte ledger never drained",
+                ingress=sc3.governor.stats(),
+            )
+        if not _wait(lambda: sc3.governor.stats()["connections"] == 0, 30):
+            return _fail(
+                "bodied_flood", detail="connection ledger never drained",
+                ingress=sc3.governor.stats(),
+            )
+        flood_summary = {
+            "noisy_sheds": noisy_sheds,
+            "gold_sheds": gold_sheds,
+            "base_p99_s": round(base_p99, 4),
+            "flood_p99_s": round(flood_p99, 4),
+            "lane_windows": {
+                lane: lanes[lane]["windows_total"] for lane in lanes
+            },
+            "scheduler_retunes": sum(
+                sc3.stats()["scheduler"].get("retunes_total", {}).values()
+            ),
+        }
+
         if sc.serving_mode() not in ("promoted", "fallback"):
             return _fail("final_mode", mode=sc.serving_mode())
         if not _wait(lambda: sc.batcher.inflight_windows() == 0, 30):
@@ -618,6 +791,8 @@ def main() -> int:
         sc.stop()
         if sc2 is not None:
             sc2.stop()
+        if sc3 is not None:
+            sc3.stop()
         srv.stop()
         shutil.rmtree(state_dir, ignore_errors=True)
         for var in list(os.environ):
@@ -661,6 +836,7 @@ def main() -> int:
                 "restart_ready_s": round(ready_s, 3),
                 "device_loss": dl.stats(),
                 "poison": poison_summary,
+                "bodied_flood": flood_summary,
             }
         )
     )

@@ -245,6 +245,34 @@ def _fam_header_noise(rng):
     ), True
 
 
+def _fam_lane_mix(rng):
+    # Priority lanes (ISSUE 16): headers-only GETs (interactive lane)
+    # interleaved with bodied POSTs (bulk lane), pipelined on ONE
+    # connection. The lanes dispatch independently but the connection
+    # must still answer in request order with the same taxonomy on both
+    # frontends.
+    k = rng.randint(2, 10)
+    out = []
+    for i in range(k):
+        close = i == k - 1
+        if rng.random() < 0.5:
+            uri = (
+                f"/?pet=evilmonkey&lm={i}"
+                if rng.random() < 0.4
+                else f"/mix{i}?q={_word(rng)}"
+            ).encode()
+            out.append(_get(rng, uri, close=close))
+        else:
+            body = _body_text(rng, 512)
+            conn = b"Connection: close\r\n" if close else b""
+            out.append(
+                b"POST /mix HTTP/1.1\r\nHost: fuzz\r\n"
+                + b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                + conn + b"\r\n" + body
+            )
+    return b"".join(out), True
+
+
 FAMILIES = [
     ("clean_get", _fam_clean_get, 10),
     ("attack_get", _fam_attack_get, 8),
@@ -263,6 +291,7 @@ FAMILIES = [
     ("chunked_oversized", _fam_chunked_oversized, 5),
     ("bad_version", _fam_bad_version, 3),
     ("header_noise", _fam_header_noise, 6),
+    ("lane_mix", _fam_lane_mix, 6),
 ]
 RESET_RATE = 0.03  # built-in mid-stream RST floor (parity not compared)
 

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Adaptive scheduler smoke (ISSUE 16 CI satellite).
+
+Drives the SAME bursty open-loop request schedule through four
+``TpuEngineSidecar`` boots sharing one ``WafEngine``: three with the
+adaptive scheduler DISABLED at static batch delays (~0.25 / 2 / 8 ms —
+the tails of the latency/throughput trade), and one with the adaptive
+scheduler ON starting from the middle delay. Asserts:
+
+1. adaptive p99 <= RATIO x the BEST static p99 (default 1.25: the
+   controller must compete with the best hand-tuned static point on
+   this workload, without knowing it in advance), and
+2. the four runs' verdicts are BIT-IDENTICAL per request (status +
+   x-waf-action + x-waf-rule-id): retuning knobs must never alter a
+   verdict.
+
+On a single-core runner the acceptor, batcher, scheduler and XLA all
+timeshare one CPU and burst p99 is dominated by scheduling noise, so
+the latency gate degrades (loudly) to "bounded + bit-identical
+verdicts"; CI runners are multicore and keep the strict bar.
+
+Usage: sched_smoke.py [--ratio 1.25] [--requests 1200] [--conns 4]
+[--burst 40] (env overrides: SCHED_SMOKE_RATIO / _REQUESTS / _CONNS /
+_BURST). Exit 0 on pass; 1 with a JSON diagnostic line on fail.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+IDLE_BETWEEN_BURSTS_S = 0.08
+
+
+def _request_bytes(req) -> bytes:
+    uri = req.uri.replace(" ", "%20")
+    lines = [f"{req.method} {uri} HTTP/1.1"]
+    for k, v in req.headers:
+        lines.append(f"{k}: {v}")
+    if req.body:
+        lines.append(f"Content-Length: {len(req.body)}")
+    head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+    return head + (req.body or b"")
+
+
+def _read_response(f):
+    status_line = f.readline()
+    if not status_line:
+        raise ConnectionError("server closed connection mid-stream")
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        ln = f.readline()
+        if ln in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = ln.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", 0))
+    if length:
+        f.read(length)
+    return (status, headers.get("x-waf-action"), headers.get("x-waf-rule-id"))
+
+
+def _burst_worker(port, payloads, burst, out, idx):
+    """Open-loop bursts: fire a pipelined burst, drain it recording the
+    time from burst start to each response, idle, repeat."""
+    try:
+        verdicts, lats = [], []
+        s = socket.create_connection(("127.0.0.1", port), timeout=120)
+        try:
+            f = s.makefile("rb")
+            for i in range(0, len(payloads), burst):
+                group = payloads[i : i + burst]
+                t0 = time.monotonic()
+                s.sendall(b"".join(group))
+                for _ in group:
+                    verdicts.append(_read_response(f))
+                    lats.append(time.monotonic() - t0)
+                time.sleep(IDLE_BETWEEN_BURSTS_S)
+        finally:
+            s.close()
+        out[idx] = (verdicts, lats)
+    except BaseException as err:
+        out[idx] = err
+
+
+def _drive(port, payloads, conns, burst):
+    shares = [payloads[i::conns] for i in range(conns)]
+    out = [None] * conns
+    threads = [
+        threading.Thread(
+            target=_burst_worker, args=(port, shares[i], burst, out, i)
+        )
+        for i in range(conns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in out:
+        if isinstance(r, BaseException):
+            raise r
+    verdicts = [None] * len(payloads)
+    lats = []
+    for i in range(conns):
+        verdicts[i::conns] = out[i][0]
+        lats.extend(out[i][1])
+    return verdicts, lats
+
+
+def _p99(samples):
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, max(0, (99 * len(xs) + 99) // 100 - 1))]
+
+
+def main() -> int:
+    ratio_env = os.environ.get("SCHED_SMOKE_RATIO")
+    ratio = float(ratio_env) if ratio_env else 1.25
+    ratio_explicit = ratio_env is not None
+    n_requests = int(os.environ.get("SCHED_SMOKE_REQUESTS", "1200"))
+    conns = int(os.environ.get("SCHED_SMOKE_CONNS", "4"))
+    burst = int(os.environ.get("SCHED_SMOKE_BURST", "40"))
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--ratio":
+            ratio = float(args.pop(0))
+            ratio_explicit = True
+        elif a == "--requests":
+            n_requests = int(args.pop(0))
+        elif a == "--conns":
+            conns = int(args.pop(0))
+        elif a == "--burst":
+            burst = int(args.pop(0))
+    single_core = (os.cpu_count() or 1) <= 1
+    degraded = single_core and not ratio_explicit
+    if degraded:
+        # One core: burst p99 is GIL/XLA timesharing noise, not a knob
+        # signal. Keep the verdict-parity gate strict; bound the latency
+        # gate loosely so only a pathological regression fails.
+        ratio = 8.0
+
+    os.environ.setdefault("CKO_VALUE_CACHE_MB", "0")
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.corpus import (
+        synthetic_crs,
+        synthetic_requests,
+    )
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    configure_persistent_cache(os.environ.get("CKO_COMPILE_CACHE_DIR"))
+    eng = WafEngine(synthetic_crs(40, seed=3))
+    payloads = [
+        _request_bytes(r)
+        for r in synthetic_requests(n_requests, attack_ratio=0.2, seed=11)
+    ]
+    warm = payloads[: min(256, len(payloads))]
+
+    STATIC_DELAYS_MS = (0.25, 2.0, 8.0)
+    configs = [
+        (f"static-{d}ms", d, False) for d in STATIC_DELAYS_MS
+    ] + [("adaptive", 2.0, True)]
+
+    runs = {}
+    sched_stats = None
+    for name, delay_ms, adaptive in configs:
+        sc = TpuEngineSidecar(
+            SidecarConfig(
+                host="127.0.0.1",
+                port=0,
+                max_batch_size=128,
+                max_batch_delay_ms=delay_ms,
+                adaptive_enabled=adaptive,
+                # Fast control loop for a short smoke: the production
+                # default (0.5s) would barely tick inside one run.
+                sched_interval_s=0.1,
+                slo_p99_ms=25.0,
+            ),
+            engine=eng,
+        )
+        sc.start()
+        try:
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline and sc.serving_mode() != "promoted":
+                time.sleep(0.05)
+            _drive(sc.port, warm, conns, burst)  # untimed warm
+            verdicts, lats = _drive(sc.port, payloads, conns, burst)
+            runs[name] = (verdicts, _p99(lats))
+            if adaptive:
+                sched_stats = sc.stats().get("scheduler", {})
+        finally:
+            sc.stop()
+
+    base_verdicts = runs[configs[0][0]][0]
+    identical = all(runs[name][0] == base_verdicts for name, _, _ in configs)
+    blocked = sum(1 for v in base_verdicts if v[1] == "deny")
+    static_p99s = {
+        name: runs[name][1] for name, _, adaptive in configs if not adaptive
+    }
+    adaptive_p99 = runs["adaptive"][1]
+    best_static = min(static_p99s.values())
+    verdict = {
+        "static_p99_s": {k: round(v, 4) for k, v in static_p99s.items()},
+        "adaptive_p99_s": round(adaptive_p99, 4),
+        "best_static_p99_s": round(best_static, 4),
+        "required_ratio": ratio,
+        "achieved_ratio": round(adaptive_p99 / max(best_static, 1e-9), 3),
+        "requests": n_requests,
+        "conns": conns,
+        "burst": burst,
+        "verdicts_identical": identical,
+        "blocked": blocked,
+        "scheduler": {
+            "retunes_total": (sched_stats or {}).get("retunes_total"),
+            "lane_delay_ms": (sched_stats or {}).get("lane_delay_ms"),
+            "pipeline_depth": (sched_stats or {}).get("pipeline_depth"),
+        },
+        "cpus": os.cpu_count(),
+        "single_core_degraded_gate": degraded,
+    }
+    ok = (
+        adaptive_p99 <= best_static * ratio
+        and identical
+        and blocked > 0
+    )
+    verdict["smoke"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

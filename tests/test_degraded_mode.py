@@ -332,7 +332,9 @@ def test_deadline_header_falls_back_when_device_misses_it(monkeypatch):
     sc.start()
     try:
         # Wedge the device path: futures never resolve.
-        sc.batcher.submit = lambda request, tenant=None, span=None: Future()
+        sc.batcher.submit = lambda request, tenant=None, span=None, lane=None: (
+            Future()
+        )
         t0 = time.monotonic()
         status, _, _ = _http(
             sc.port,
@@ -354,10 +356,11 @@ def test_load_shedding_429(monkeypatch):
     sc = _sidecar(engine, queue_budget=8, shed_retry_after_s=2.0)
     sc.start()
     try:
-        sc.batcher.pending = lambda: 100  # simulated backlog over budget
+        sc.batcher.pending = lambda lane=None: 100  # backlog over budget
         status, headers, body = _http(sc.port, "/?pet=evilmonkey")
         assert status == 429
-        assert headers["Retry-After"] == "2"
+        # Live queue-depth Retry-After: 100/8 caps at 8x the 2.0s base.
+        assert headers["Retry-After"] == "16"
         assert headers["x-waf-action"] == "shed"
         payload = json.dumps({"requests": [{"uri": "/x"}]}).encode()
         status, headers, body = _http(

@@ -402,10 +402,12 @@ def test_window_shedding_429(engine):
     try:
         assert _wait(sc.ready)
         assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
-        sc.batcher.pending = lambda: 100  # simulated backlog over budget
+        sc.batcher.pending = lambda lane=None: 100  # backlog over budget
         status, headers, body = _http(sc.port, "/?pet=evilmonkey")
         assert status == 429
-        assert headers["Retry-After"] == "2"
+        # Retry-After scales with live queue depth: 100/8 caps at the 8x
+        # multiplier, so 2.0s base becomes 16s.
+        assert headers["Retry-After"] == "16"
         assert headers["x-waf-action"] == "shed"
         assert b"overloaded" in body
         status, _, _ = _http(sc.port, "/clean")
@@ -439,16 +441,17 @@ def test_429_shed_header_parity_both_frontends(engine):
         try:
             assert _wait(sc.ready)
             assert _wait(lambda: sc.serving_mode() == "promoted", timeout_s=60)
-            sc.batcher.pending = lambda: 100  # simulated backlog over budget
+            sc.batcher.pending = lambda lane=None: 100  # backlog over budget
             status, headers, _ = _http(sc.port, "/?q=ok")
             assert status == 429, frontend
-            assert headers["Retry-After"] == "2", (frontend, headers)
+            # Live queue-depth Retry-After: 100/8 caps at 8x the 2.0s base.
+            assert headers["Retry-After"] == "16", (frontend, headers)
             assert headers["x-waf-action"] == "shed", (frontend, headers)
             status, headers, body = _http(
                 sc.port, "/waf/v1/evaluate", method="POST", body=payload
             )
             assert status == 429, (frontend, body)
-            assert headers["Retry-After"] == "2", (frontend, headers)
+            assert headers["Retry-After"] == "16", (frontend, headers)
             assert headers["x-waf-action"] == "shed", (frontend, headers)
         finally:
             sc.stop()

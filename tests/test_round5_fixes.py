@@ -107,7 +107,9 @@ def test_warmed_engine_uses_strict_timeout():
         engine=engine,
     )
     engine.warmed = True
-    sc.batcher.submit = lambda request, tenant=None: Future()  # never resolves
+    sc.batcher.submit = (
+        lambda request, tenant=None, lane=None, **kw: Future()
+    )  # never resolves
     t0 = time.monotonic()
     with pytest.raises(FutTimeout):
         sc.evaluate_many(
