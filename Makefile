@@ -67,6 +67,10 @@ ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontend
 sched.smoke:  ## Adaptive scheduler gate: adaptive p99 <= best static delay, verdicts identical.
 	$(PYTHON) hack/sched_smoke.py
 
+.PHONY: cache.smoke
+cache.smoke:  ## Verdict cache gate: cache-on >= 2x uncached req/s on Zipfian traffic, verdicts identical.
+	$(PYTHON) hack/verdict_cache_smoke.py
+
 .PHONY: chaos.smoke
 chaos.smoke:  ## Sidecar under the fault matrix: stall, divergence, device storm, outage, ingress storm, crash-restart, device loss, poison storm.
 	$(PYTHON) hack/chaos_smoke.py

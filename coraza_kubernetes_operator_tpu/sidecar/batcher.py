@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 from ..engine.request import HttpRequest
 from ..engine.waf import Verdict, WafEngine
 from ..utils import get_logger
+from .quarantine import fingerprint
 
 log = get_logger("sidecar.batcher")
 
@@ -335,6 +336,19 @@ class _Group:
     # Materialized requests, kept only where a later stage needs them
     # (quarantined groups; blob split groups for fault classification).
     reqs: list | None = None
+    # Verdict-cache fast path (sidecar/verdict_cache.py). ``cached``
+    # marks a group whose verdicts were answered from the cache at
+    # assembly time — never dispatched to device, no breaker traffic,
+    # no device stats, no shadow mirror. On DEVICE groups, ``fps``
+    # carries the fingerprints of cache-eligible rows (window idx ->
+    # fp) for insertion at collect, ``dups`` the in-window duplicate
+    # scatter map (unique idx -> duplicate idxs answered by the same
+    # verdict), and ``cache_uuid`` pins the compiled-ruleset identity
+    # the cache keys on, resolved at dispatch time.
+    cached: bool = False
+    fps: dict | None = None
+    dups: dict | None = None
+    cache_uuid: object = None
 
 
 @dataclass
@@ -540,6 +554,21 @@ class MicroBatcher:
         self.quarantine = None
         self.fallback_evaluate = None  # (engine, requests) -> list[Verdict]
         self.on_window_fault = None  # (engine, err, requests_fn|None) -> None
+        # Verdict cache (sidecar/verdict_cache.py): consulted at
+        # batch-assembly time — AFTER the quarantine check (quarantine
+        # wins), and never for trusted-tenant or ``no_cache`` (deadline-
+        # header) rows. Hits resolve their futures during dispatch;
+        # misses are deduped in-window (identical fingerprints ride the
+        # device once, verdicts scattered to every requester at collect)
+        # and inserted when their device verdicts land.
+        # cache_key_fn(engine) -> ruleset uuid names the compiled
+        # ruleset in the cache key; unset, id(engine) stands in (the
+        # sidecar's wholesale invalidation on swap guards staleness).
+        self.verdict_cache = None
+        self.cache_key_fn = None  # (engine,) -> ruleset uuid
+        # Duplicate rows served by in-window scatter instead of a device
+        # slot (the cko_window_dedup_rows_total metric).
+        self.window_dedup_rows = 0
         # Collector-leak visibility: stop() flips this when the collect
         # thread outlives its join budget instead of leaking silently.
         self.collector_wedged = False
@@ -730,7 +759,7 @@ class MicroBatcher:
             _resolve(bw.fut.set_exception, EngineUnavailable("batcher stopped"))
 
     def _drain_triple(self, item) -> None:
-        req, tenant, fut, span = item
+        req, tenant, fut, span, _no_cache = item
         if fut.cancelled():
             return
         if span is not None:
@@ -749,18 +778,22 @@ class MicroBatcher:
         tenant: str | None = None,
         span=None,
         lane: str | None = None,
+        no_cache: bool = False,
     ) -> Future:
         """Enqueue one request; the Future resolves to its Verdict.
         ``span`` is an optional flight-recorder SpanContext; the collect
         stage stamps the pipeline spans onto it before the future
         resolves. ``lane`` pins a priority lane; unset, the request is
-        classified by body presence (bodied → bulk)."""
+        classified by body presence (bodied → bulk). ``no_cache`` keeps
+        the row off the verdict cache entirely (the server marks
+        deadline-header requests — their rescue/cancel dance must see
+        the unmodified device path)."""
         fut: Future = Future()
         if span is not None:
             span.t_submit = time.monotonic()
         if lane is None:
             lane = classify_lane(request)
-        self._queues[lane].put((request, tenant, fut, span))
+        self._queues[lane].put((request, tenant, fut, span, no_cache))
         return fut
 
     def submit_window(
@@ -941,11 +974,25 @@ class MicroBatcher:
         registry = self.quarantine
         if registry is not None and not len(registry):
             registry = None
+        # Verdict-cache gate: same shape — disabled costs one attribute
+        # read and the window never fingerprints anything.
+        vcache = self.verdict_cache
+        if vcache is not None and not vcache.enabled:
+            vcache = None
+        # Per-engine fingerprint bookkeeping (cache-enabled windows
+        # only): fps maps dispatched idx -> fingerprint (insert at
+        # collect), dups maps a unique row to the duplicates riding it,
+        # seen dedups fingerprints within this window.
+        group_fps: dict[int, dict[int, str]] = {}
+        group_dups: dict[int, dict[int, list[int]]] = {}
+        group_seen: dict[int, dict[str, int]] = {}
+        uuid_cache: dict[int, object] = {}
+        dedup_rows = 0
         # engine_fn resolved once per DISTINCT tenant (it may take the
         # tenant-manager lock); memoizing also pins one engine per tenant
         # for the whole window even if a hot reload lands mid-grouping.
         tenant_cache: dict[str | None, WafEngine | None] = {}
-        for idx, (_req, tenant, _fut, _span) in enumerate(window):
+        for idx, (_req, tenant, _fut, _span, _no_cache) in enumerate(window):
             if _fut.cancelled():
                 # Deadline-missed request already answered by the host
                 # fallback — don't spend a device slot on it.
@@ -963,7 +1010,34 @@ class MicroBatcher:
                 # collect stage — it never rides a device window again.
                 quarantined.setdefault(key, []).append(idx)
                 continue
+            if vcache is not None and tenant is None and not _no_cache:
+                # Cache-eligible row: quarantine already said no, the
+                # default tenant serves it, and no deadline rides it.
+                fp = fingerprint(_req)
+                if key not in uuid_cache:
+                    uuid_cache[key] = self._cache_uuid(engine)
+                verdict = vcache.lookup(None, uuid_cache[key], fp)
+                if verdict is not None:
+                    # Fast path: answered at assembly time — the row
+                    # never rides the device or waits on the FIFO.
+                    self._trace_cached_span(_span)
+                    _resolve(_fut.set_result, verdict)
+                    continue
+                seen = group_seen.setdefault(key, {})
+                first = seen.get(fp)
+                if first is not None:
+                    # In-window duplicate: rides the first occurrence's
+                    # device row; its verdict scatters at collect.
+                    group_dups.setdefault(key, {}).setdefault(
+                        first, []
+                    ).append(idx)
+                    dedup_rows += 1
+                    continue
+                seen[fp] = idx
+                group_fps.setdefault(key, {})[idx] = fp
             groups.setdefault(key, []).append(idx)
+        if dedup_rows:
+            self.window_dedup_rows += dedup_rows
         out_groups: list[_Group] = []
         for key, idxs in quarantined.items():
             out_groups.append(
@@ -988,7 +1062,14 @@ class MicroBatcher:
             )
         for key, idxs in groups.items():
             engine = group_engine[key]
-            g = _Group(engine=engine, idxs=idxs, t_dispatch=time.monotonic())
+            g = _Group(
+                engine=engine,
+                idxs=idxs,
+                t_dispatch=time.monotonic(),
+                fps=group_fps.get(key),
+                dups=group_dups.get(key),
+                cache_uuid=uuid_cache.get(key),
+            )
             reqs = [window[i][0] for i in idxs]
             try:
                 if self.phase_split or not hasattr(engine, "prepare"):
@@ -1014,13 +1095,19 @@ class MicroBatcher:
         t_win = time.monotonic()
         engine = self._engine_fn(None)
         registry = self.quarantine
-        if engine is not None and registry is not None and len(registry):
+        if registry is not None and not len(registry):
+            registry = None
+        vcache = self.verdict_cache
+        if vcache is not None and not vcache.enabled:
+            vcache = None
+        if engine is not None and (registry is not None or vcache is not None):
             try:
-                record = self._dispatch_blob_split(bw, engine, registry)
+                record = self._dispatch_blob_split(bw, engine, registry, vcache)
             except Exception as err:
                 # Materialization/probe failure: fall through to the
-                # normal blob dispatch — quarantine is best-effort.
-                log.error("quarantine blob probe failed", err)
+                # normal blob dispatch — quarantine routing and the
+                # verdict cache are both best-effort.
+                log.error("blob window assembly probe failed", err)
                 record = None
             if record is not None:
                 return record
@@ -1046,37 +1133,97 @@ class MicroBatcher:
         return _WindowRecord(window=bw, groups=[g], t_win=t_win)
 
     def _dispatch_blob_split(
-        self, bw: _BlobWindow, engine, registry
+        self, bw: _BlobWindow, engine, registry, vcache=None
     ) -> _WindowRecord | None:
-        """Quarantine routing for a blob window: materialize the
-        requests, split quarantined rows from clean ones, dispatch the
-        clean remainder per-request (``engine.prepare``) and mark the
-        rest for fallback in the collect stage. Returns None when
-        nothing matched — the caller then runs the normal zero-copy blob
-        dispatch (the materialization cost only taxes windows while the
-        registry is non-empty)."""
+        """Quarantine + verdict-cache routing for a blob window:
+        materialize the requests, split quarantined rows (host fallback
+        at collect), cache-hit rows (answered at assembly), and
+        in-window duplicates (scattered at collect) from the unique
+        remainder, which dispatches per-request (``engine.prepare``).
+        Returns None when nothing matched and no cache is wired — the
+        caller then runs the normal zero-copy blob dispatch. With the
+        cache enabled but every row a unique miss, the zero-copy
+        ``prepare_blob`` dispatch is kept and only the fingerprints ride
+        along for insertion at collect."""
         from ..native import blob_requests
 
         reqs = blob_requests(bw.blob, bw.n_req)
         spans = bw.spans
-        qidx = [
-            i
-            for i, r in enumerate(reqs)
-            if registry.match(
-                r, span=spans[i] if spans and i < len(spans) else None
-            )
-        ]
-        if not qidx:
-            return None
+        qidx = []
+        if registry is not None:
+            qidx = [
+                i
+                for i, r in enumerate(reqs)
+                if registry.match(
+                    r, span=spans[i] if spans and i < len(spans) else None
+                )
+            ]
         qset = set(qidx)
-        groups: list[_Group] = []
-        clean_idx = [i for i in range(bw.n_req) if i not in qset]
-        if clean_idx:
+        cached_idx: list[int] = []
+        cached_verdicts: list[Verdict] = []
+        device_idx: list[int] = []
+        fps: dict[int, str] = {}
+        dups: dict[int, list[int]] = {}
+        uuid = None
+        if vcache is not None:
+            uuid = self._cache_uuid(engine)
+            seen: dict[str, int] = {}
+            for i, r in enumerate(reqs):
+                if i in qset:
+                    continue
+                fp = fingerprint(r)
+                verdict = vcache.lookup(None, uuid, fp)
+                if verdict is not None:
+                    self._trace_cached_span(
+                        spans[i] if spans and i < len(spans) else None
+                    )
+                    cached_idx.append(i)
+                    cached_verdicts.append(verdict)
+                    continue
+                first = seen.get(fp)
+                if first is not None:
+                    dups.setdefault(first, []).append(i)
+                    continue
+                seen[fp] = i
+                fps[i] = fp
+                device_idx.append(i)
+        else:
+            device_idx = [i for i in range(bw.n_req) if i not in qset]
+        if dups:
+            self.window_dedup_rows += sum(len(v) for v in dups.values())
+        if not qidx and not cached_idx and not dups:
+            if vcache is None:
+                return None
+            # Every row is a unique miss: keep the zero-copy blob
+            # dispatch; the fingerprints ride along so the collect
+            # stage can warm the cache from the fresh verdicts.
             g = _Group(
                 engine=engine,
-                idxs=clean_idx,
+                idxs=list(range(bw.n_req)),
                 t_dispatch=time.monotonic(),
-                reqs=[reqs[i] for i in clean_idx],
+                fps=fps,
+                cache_uuid=uuid,
+            )
+            try:
+                if not self.phase_split and hasattr(engine, "prepare_blob"):
+                    g.inflight = engine.prepare_blob(bw.blob, bw.n_req)
+                elif self.phase_split:
+                    g.verdicts = engine.evaluate_phased(reqs)
+                else:
+                    g.verdicts = engine.evaluate(reqs)
+            except Exception as err:
+                g.error = err
+            return _WindowRecord(window=bw, groups=[g], t_win=time.monotonic())
+        groups: list[_Group] = []
+        if device_idx:
+            g = _Group(
+                engine=engine,
+                idxs=device_idx,
+                t_dispatch=time.monotonic(),
+                reqs=[reqs[i] for i in device_idx],
+                fps=fps or None,
+                dups=dups or None,
+                cache_uuid=uuid,
             )
             try:
                 if self.phase_split:
@@ -1088,15 +1235,26 @@ class MicroBatcher:
             except Exception as err:
                 g.error = err
             groups.append(g)
-        groups.append(
-            _Group(
-                engine=engine,
-                idxs=qidx,
-                t_dispatch=time.monotonic(),
-                quarantined=True,
-                reqs=[reqs[i] for i in qidx],
+        if cached_idx:
+            groups.append(
+                _Group(
+                    engine=engine,
+                    idxs=cached_idx,
+                    t_dispatch=time.monotonic(),
+                    cached=True,
+                    verdicts=cached_verdicts,
+                )
             )
-        )
+        if qidx:
+            groups.append(
+                _Group(
+                    engine=engine,
+                    idxs=qidx,
+                    t_dispatch=time.monotonic(),
+                    quarantined=True,
+                    reqs=[reqs[i] for i in qidx],
+                )
+            )
         return _WindowRecord(
             window=bw, groups=groups, split=True, t_win=time.monotonic()
         )
@@ -1123,7 +1281,8 @@ class MicroBatcher:
                     if not record.window.fut.done():
                         _resolve(record.window.fut.set_exception, err)
                 else:
-                    for _req, _tenant, fut, _span in record.window:
+                    for item in record.window:
+                        fut = item[2]
                         if not fut.done():
                             _resolve(fut.set_exception, err)
             finally:
@@ -1340,6 +1499,51 @@ class MicroBatcher:
         for i, verdict in zip(g.idxs, verdicts):
             _resolve(record.window[i][2].set_result, verdict)
 
+    # -- verdict cache (sidecar/verdict_cache.py) ----------------------------
+
+    def _cache_uuid(self, engine):
+        """Cache-key component naming the engine's compiled ruleset.
+        Falls back to ``id(engine)`` when no resolver is wired (raw
+        batcher users) — the sidecar's wholesale invalidation on every
+        swap still guards staleness."""
+        fn = self.cache_key_fn
+        if fn is not None:
+            try:
+                uuid = fn(engine)
+                if uuid is not None:
+                    return uuid
+            except Exception as err:
+                log.error("cache_key_fn hook failed", err)
+        return id(engine)
+
+    def _cache_insert(self, g: _Group) -> None:
+        """Remember a device group's fresh verdicts under the
+        fingerprints computed at assembly time (collect stage; a
+        failing cache must never decide a verdict)."""
+        vcache = self.verdict_cache
+        if vcache is None or not g.fps or g.verdicts is None:
+            return
+        try:
+            for i, verdict in zip(g.idxs, g.verdicts):
+                fp = g.fps.get(i)
+                if fp is not None:
+                    vcache.insert(None, g.cache_uuid, fp, verdict)
+        except Exception as err:
+            log.error("verdict cache insert failed", err)
+
+    @staticmethod
+    def _trace_cached_span(span) -> None:
+        """Stamp a verdict-cache hit onto one flight record (no-op for
+        untraced requests; never raises)."""
+        if span is None or not getattr(span, "recording", False):
+            return
+        try:
+            now = time.monotonic()
+            span.annotate_path("verdict_cache")
+            span.event("verdict_cache_hit", now, now, track="pipeline")
+        except Exception as err:
+            log.error("flight recorder stamp failed", err)
+
     def _collect_record(self, record: _WindowRecord) -> None:
         if isinstance(record.window, _BlobWindow):
             self._collect_blob(record)
@@ -1373,6 +1577,10 @@ class MicroBatcher:
                     self._trace_degraded(record, g, "error", "window_error")
                 for i in g.idxs:
                     _resolve(record.window[i][2].set_exception, g.error)
+                    for j in g.dups.get(i, ()) if g.dups else ():
+                        # Duplicates share their unique row's fate — the
+                        # server's rescue paths re-answer each future.
+                        _resolve(record.window[j][2].set_exception, g.error)
                 continue
             self._notify(self.on_engine_success, g.engine)
             spans = self._group_spans(record, g)
@@ -1403,6 +1611,11 @@ class MicroBatcher:
                 self._trace_group(record, g, spans)
             for i, verdict in zip(g.idxs, g.verdicts):
                 _resolve(record.window[i][2].set_result, verdict)
+                for j in g.dups.get(i, ()) if g.dups else ():
+                    # In-window duplicate: the SAME verdict answers
+                    # every requester that shared the fingerprint.
+                    _resolve(record.window[j][2].set_result, verdict)
+            self._cache_insert(g)
             if self.on_window is not None:
                 inflight = g.inflight
                 serving_s = (
@@ -1475,6 +1688,7 @@ class MicroBatcher:
         if spans:
             self._trace_group(record, g, spans)
         _resolve(bw.fut.set_result, list(g.verdicts))
+        self._cache_insert(g)
         if self.on_window is not None and (
             self.window_wanted is None or self._wants_window(g.engine)
         ):
@@ -1505,6 +1719,11 @@ class MicroBatcher:
                 if g.quarantined:
                     self._trace_degraded(record, g, "quarantine", "quarantine")
                     verdicts = self._quarantine_eval(g)
+                elif g.cached:
+                    # Answered from the verdict cache at assembly time:
+                    # no device step, no breaker traffic, no stats
+                    # sample — the hit accounting lives in the cache.
+                    verdicts = g.verdicts
                 else:
                     if g.error is not None:
                         raise g.error
@@ -1516,12 +1735,12 @@ class MicroBatcher:
                 log.error(
                     "split blob window evaluation failed", err, batch=bw.n_req
                 )
-                if not g.quarantined and g.engine is not None:
+                if not g.quarantined and not g.cached and g.engine is not None:
                     g.error = err
                     self._window_fault(g, lambda g=g: g.reqs)
                 _resolve(bw.fut.set_exception, err)
                 return
-            if not g.quarantined:
+            if not g.quarantined and not g.cached:
                 self._notify(self.on_engine_success, g.engine)
                 spans = self._group_spans(record, g)
                 try:
@@ -1536,6 +1755,11 @@ class MicroBatcher:
                     self._trace_group(record, g, spans)
             for i, verdict in zip(g.idxs, verdicts):
                 out[i] = verdict
+                for j in g.dups.get(i, ()) if g.dups else ():
+                    # In-window duplicate: the SAME verdict answers
+                    # every row that shared the fingerprint.
+                    out[j] = verdict
+            self._cache_insert(g)
         _resolve(bw.fut.set_result, out)
 
     def _wants_window(self, engine) -> bool:

@@ -100,6 +100,7 @@ _CONTROL_TARGETS = {
     b"/waf/v1/metrics",
     b"/waf/v1/rollback",
     b"/waf/v1/quarantine/flush",
+    b"/waf/v1/cache/flush",
     b"/waf/v1/trace",
     b"/waf/v1/profile",
 }
@@ -929,6 +930,8 @@ class AsyncIngestFrontend:
                 return self._spawn(
                     self._ctl_pool, sc.quarantine_flush_reply, body
                 )
+            if path == API_PREFIX + "cache/flush":
+                return self._spawn(self._ctl_pool, sc.cache_flush_reply, body)
             if path == API_PREFIX + "profile":
                 auth = special.get(b"authorization")
                 return self._spawn(

@@ -110,6 +110,20 @@ class QuarantineRegistry:
         self.hits_total = 0
         self.isolated_total = 0
         self.flushes = 0
+        # Quarantine/verdict-cache interop (sidecar/verdict_cache.py):
+        # a fingerprint quarantined AFTER its verdict was cached must
+        # not keep serving the cached allow. The sidecar wires this to
+        # ``VerdictCache.evict_fingerprint``; fired on every add.
+        self.on_add = None  # (fp,) -> None
+
+    def _notify_add(self, fp: str) -> None:
+        hook = self.on_add
+        if hook is None:
+            return
+        try:
+            hook(fp)
+        except Exception as err:  # interop must never block isolation
+            log.error("quarantine on_add hook failed", err)
 
     def __len__(self) -> int:
         with self._lock:
@@ -132,6 +146,7 @@ class QuarantineRegistry:
                 del self._entries[oldest]
             self._entries[fp] = time.monotonic() + self.ttl_s
             self.isolated_total += 1
+        self._notify_add(fp)
 
     def match(self, req: HttpRequest, span=None) -> bool:
         """True when the request is quarantined (counts a hit).

@@ -74,6 +74,7 @@ from .governor import (
 )
 from .scheduler import AdaptiveScheduler
 from .quarantine import PoisonBisector, QuarantineRegistry
+from .verdict_cache import VerdictCache
 from .reloader import DEFAULT_POLL_INTERVAL_S
 from .rollout import RolloutConfig, RolloutManager
 from .state_store import StateStore
@@ -370,6 +371,7 @@ _CONTROL_PATHS = {
     API_PREFIX + "metrics",
     API_PREFIX + "rollback",
     API_PREFIX + "quarantine/flush",
+    API_PREFIX + "cache/flush",
     API_PREFIX + "trace",
     API_PREFIX + "profile",
 }
@@ -577,6 +579,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_rollback(body)
             elif path == API_PREFIX + "quarantine/flush":
                 self._reply(*self.sidecar.quarantine_flush_reply(body))
+            elif path == API_PREFIX + "cache/flush":
+                self._reply(*self.sidecar.cache_flush_reply(body))
             elif path == API_PREFIX + "profile":
                 self._reply(
                     *self.sidecar.profile_reply(
@@ -1173,6 +1177,16 @@ class TpuEngineSidecar:
         self.batcher.quarantine = self.quarantine
         self.batcher.fallback_evaluate = self._drain_evaluate
         self.batcher.on_window_fault = self._on_window_fault
+        # Fingerprint verdict cache (sidecar/verdict_cache.py): repeated
+        # requests are answered at batch-assembly time from the verdict
+        # the engine already produced. Invalidated wholesale on EVERY
+        # engine swap (_on_engine_swap); a quarantined fingerprint
+        # evicts its cached verdict immediately (a cached allow must
+        # not outlive its quarantine).
+        self.verdict_cache = VerdictCache()
+        self.batcher.verdict_cache = self.verdict_cache
+        self.batcher.cache_key_fn = self.tenants.ruleset_uuid_for
+        self.quarantine.on_add = self.verdict_cache.evict_fingerprint
         self.metrics.gauge(
             "cko_windows_abandoned_total",
             "Windows abandoned by the dispatch watchdog (deadline blown;"
@@ -1198,6 +1212,28 @@ class TpuEngineSidecar:
             "cko_quarantine_isolated_total",
             "Poison requests isolated by the window bisector",
         ).set_function(lambda: float(self.quarantine.isolated_total))
+        self.metrics.gauge(
+            "cko_verdict_cache_entries",
+            "Fingerprint verdicts currently held by the cache",
+        ).set_function(lambda: float(len(self.verdict_cache)))
+        self.metrics.gauge(
+            "cko_verdict_cache_hits_total",
+            "Requests answered from the verdict cache without a device step",
+        ).set_function(lambda: float(self.verdict_cache.hits_total))
+        self.metrics.gauge(
+            "cko_verdict_cache_misses_total",
+            "Cache-eligible requests that rode a device window",
+        ).set_function(lambda: float(self.verdict_cache.misses_total))
+        self.metrics.gauge(
+            "cko_verdict_cache_invalidations_total",
+            "Cached verdicts dropped by ruleset swaps, quarantine"
+            " evictions, and operator flushes",
+        ).set_function(lambda: float(self.verdict_cache.invalidations_total))
+        self.metrics.gauge(
+            "cko_window_dedup_rows_total",
+            "Duplicate in-window rows served by verdict scatter instead"
+            " of a device slot",
+        ).set_function(lambda: float(self.batcher.window_dedup_rows))
         # Graceful drain: windows still queued at stop() are EVALUATED
         # (host fallback when available) within the drain budget instead
         # of failing — an accepted request never loses its verdict.
@@ -1489,6 +1525,15 @@ class TpuEngineSidecar:
     # -- degraded-mode helpers ----------------------------------------------
 
     def _on_engine_swap(self, engine) -> None:
+        # Wholesale verdict-cache invalidation: EVERY engine transition
+        # funnels through here (inline reload swap, rollout promotion,
+        # forced rollback, warm restore, seed) — a verdict must never
+        # outlive the compiled ruleset that produced it.
+        vcache = getattr(self, "verdict_cache", None)
+        if vcache is not None:
+            dropped = vcache.invalidate_all()
+            if dropped:
+                log.info("verdict cache invalidated on engine swap", dropped=dropped)
         degraded = getattr(self, "degraded", None)
         if degraded is not None and engine is not None:
             degraded.ensure_probe(engine)
@@ -1722,6 +1767,18 @@ class TpuEngineSidecar:
         log.info("quarantine flushed", flushed=flushed)
         return _json_reply(
             200, {"flushed": flushed, "entries": len(self.quarantine)}
+        )
+
+    def cache_flush_reply(self, body: bytes) -> tuple[int, bytes, dict]:
+        """Drop every cached verdict (operator escape hatch, mirroring
+        the quarantine flush semantics: auth-exempt control path on both
+        HTTP frontends). Body is accepted and ignored for forward
+        compatibility."""
+        del body
+        flushed = self.verdict_cache.flush()
+        log.info("verdict cache flushed", flushed=flushed)
+        return _json_reply(
+            200, {"flushed": flushed, "entries": len(self.verdict_cache)}
         )
 
     def trace_reply(self, query: str = "") -> tuple[int, bytes, dict]:
@@ -2140,7 +2197,16 @@ class TpuEngineSidecar:
         timeout = self._timeout_for([engine])
         if deadline_s is not None:
             timeout = max(0.001, min(timeout, deadline_s - _time.monotonic()))
-        fut = self.batcher.submit(request, tenant=tenant, span=span, lane=lane)
+        # Deadline-header requests bypass the verdict cache: their
+        # cancel/rescue dance must observe the unmodified device path
+        # (trusted-tenant requests are excluded inside the batcher).
+        fut = self.batcher.submit(
+            request,
+            tenant=tenant,
+            span=span,
+            lane=lane,
+            no_cache=deadline_s is not None,
+        )
         try:
             return fut.result(timeout=timeout)
         except EngineUnavailable:
@@ -2462,6 +2528,10 @@ class TpuEngineSidecar:
                 **self.quarantine.stats(),
                 "bisect_jobs": self.bisector.jobs_total,
                 "bisect_dropped": self.bisector.jobs_dropped,
+            },
+            "verdict_cache": {
+                **self.verdict_cache.stats(),
+                "window_dedup_rows": self.batcher.window_dedup_rows,
             },
             "request_timeout_s": self.config.request_timeout_s,
             "tracing": self.tracer.stats(),

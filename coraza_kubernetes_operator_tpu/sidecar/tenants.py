@@ -146,6 +146,20 @@ class TenantManager:
             reloader = self._reloaders.get(key)
         return reloader.engine if reloader is not None else None
 
+    def ruleset_uuid_for(self, engine) -> str | None:
+        """The ruleset uuid some tenant currently serves ``engine``
+        under, or None (seeded/unknown engines). Cache-key component for
+        the verdict cache (sidecar/verdict_cache.py); O(tenants) scan,
+        memoized per window by the batcher."""
+        if engine is None:
+            return None
+        with self._lock:
+            reloaders = list(self._reloaders.values())
+        for r in reloaders:
+            if r.engine is engine:
+                return r.current_uuid
+        return None
+
     def any_loaded(self) -> bool:
         with self._lock:
             reloaders = list(self._reloaders.values())

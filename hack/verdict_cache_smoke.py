@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Verdict cache smoke (ISSUE 17 CI satellite).
+
+Drives a Zipf-skewed open-loop request stream (repeat-heavy traffic: a
+finite pool of distinct requests sampled with a power-law — the fleet's
+"same probe, same health check, same hot call" shape) over real sockets
+through ONE ``TpuEngineSidecar``, twice:
+
+1. verdict cache unhooked — every row rides a device window (the
+   honest number), then
+2. verdict cache hooked + pre-warmed — repeats answer at batch
+   assembly, in-window duplicates share one device row
+   (docs/SERVING.md#verdict-cache--in-window-dedup),
+
+and asserts cache-on effective throughput >= RATIO x the uncached run
+(default 2.0) with BIT-IDENTICAL verdicts per request (status +
+x-waf-action + x-waf-rule-id): the cache is a fast path, it must never
+alter a verdict. The JSON diagnostic line carries the cache hit rate
+and the in-window dedup factor next to both throughput numbers.
+
+Usage: verdict_cache_smoke.py [--ratio 2.0] [--requests 3072]
+[--pool 192] [--conns 8] [--depth 32] (env overrides:
+CACHE_SMOKE_RATIO / _REQUESTS / _POOL / _CONNS / _DEPTH). Exit 0 on
+pass; 1 with the diagnostic line on fail.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _request_bytes(req) -> bytes:
+    uri = req.uri.replace(" ", "%20")
+    lines = [f"{req.method} {uri} HTTP/1.1"]
+    for k, v in req.headers:
+        lines.append(f"{k}: {v}")
+    if req.body:
+        lines.append(f"Content-Length: {len(req.body)}")
+    head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1", "replace")
+    return head + (req.body or b"")
+
+
+def _read_response(f):
+    status_line = f.readline()
+    if not status_line:
+        raise ConnectionError("server closed connection mid-stream")
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        ln = f.readline()
+        if ln in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = ln.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", 0))
+    if length:
+        f.read(length)
+    return (status, headers.get("x-waf-action"), headers.get("x-waf-rule-id"))
+
+
+def _conn_worker(port, payloads, depth, out, idx):
+    try:
+        verdicts = []
+        s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        try:
+            f = s.makefile("rb")
+            for i in range(0, len(payloads), depth):
+                group = payloads[i : i + depth]
+                s.sendall(b"".join(group))
+                for _ in group:
+                    verdicts.append(_read_response(f))
+        finally:
+            s.close()
+        out[idx] = verdicts
+    except BaseException as err:  # surfaced by _drive in the main thread
+        out[idx] = err
+
+
+def _drive(port, payloads, conns, depth):
+    """Send payloads over `conns` keep-alive connections (pipelined in
+    groups of `depth`); returns (verdicts in request order, wall_s)."""
+    shares = [payloads[i::conns] for i in range(conns)]
+    out = [None] * conns
+    threads = [
+        threading.Thread(target=_conn_worker, args=(port, shares[i], depth, out, i))
+        for i in range(conns)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    for r in out:
+        if isinstance(r, BaseException):
+            raise r
+    verdicts = [None] * len(payloads)
+    for i in range(conns):
+        verdicts[i::conns] = out[i]
+    return verdicts, wall
+
+
+def main() -> int:
+    ratio_env = os.environ.get("CACHE_SMOKE_RATIO")
+    ratio = float(ratio_env) if ratio_env else 2.0
+    ratio_explicit = ratio_env is not None
+    n_requests = int(os.environ.get("CACHE_SMOKE_REQUESTS", "3072"))
+    pool = int(os.environ.get("CACHE_SMOKE_POOL", "192"))
+    conns = int(os.environ.get("CACHE_SMOKE_CONNS", "8"))
+    depth = int(os.environ.get("CACHE_SMOKE_DEPTH", "32"))
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--ratio":
+            ratio = float(args.pop(0))
+            ratio_explicit = True
+        elif a == "--requests":
+            n_requests = int(args.pop(0))
+        elif a == "--pool":
+            pool = int(args.pop(0))
+        elif a == "--conns":
+            conns = int(args.pop(0))
+        elif a == "--depth":
+            depth = int(args.pop(0))
+    single_core = (os.cpu_count() or 1) <= 1
+    if single_core and not ratio_explicit:
+        # One core timeshares client, acceptor, batcher, and XLA: the
+        # device step the cache skips is no longer the bottleneck and
+        # the win collapses. The gate degrades (loudly) to "no
+        # regression + bit-identical verdicts"; CI runners are
+        # multicore and keep the strict 2x bar.
+        ratio = 0.9
+
+    # Honest comparison: the cross-batch VALUE cache stays off for both
+    # runs so the only variable is the verdict cache itself.
+    os.environ.setdefault("CKO_VALUE_CACHE_MB", "0")
+    sys.path.insert(0, str(REPO))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from coraza_kubernetes_operator_tpu.corpus import (
+        synthetic_crs,
+        zipfian_requests,
+    )
+    from coraza_kubernetes_operator_tpu.engine.compile_cache import (
+        configure_persistent_cache,
+    )
+    from coraza_kubernetes_operator_tpu.engine.waf import WafEngine
+    from coraza_kubernetes_operator_tpu.sidecar import (
+        SidecarConfig,
+        TpuEngineSidecar,
+    )
+
+    configure_persistent_cache(os.environ.get("CKO_COMPILE_CACHE_DIR"))
+    eng = WafEngine(synthetic_crs(40, seed=3))
+    payloads = [
+        _request_bytes(r)
+        for r in zipfian_requests(
+            n_requests, pool_size=pool, s=1.1, attack_ratio=0.2, seed=7
+        )
+    ]
+
+    sc = TpuEngineSidecar(
+        SidecarConfig(
+            host="127.0.0.1",
+            port=0,
+            max_batch_size=128,
+            max_batch_delay_ms=2.0,
+            frontend="async",
+        ),
+        engine=eng,
+    )
+    sc.start()
+    try:
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline and sc.serving_mode() != "promoted":
+            time.sleep(0.05)
+
+        # Uncached leg: unhook the cache so every row rides the device.
+        sc.batcher.verdict_cache = None
+        _drive(sc.port, payloads, conns, depth)  # untimed warm (compiles)
+        cold_verdicts, cold_wall = _drive(sc.port, payloads, conns, depth)
+
+        # Cache-on leg: rehook, one untimed pass fills the cache and
+        # mints the smaller deduped-window shapes, then time the replay.
+        sc.batcher.verdict_cache = sc.verdict_cache
+        _drive(sc.port, payloads, conns, depth)  # untimed fill
+        hot_verdicts, hot_wall = _drive(sc.port, payloads, conns, depth)
+        vc = sc.stats()["verdict_cache"]
+    finally:
+        sc.stop()
+
+    identical = hot_verdicts == cold_verdicts
+    blocked = sum(1 for v in hot_verdicts if v[1] == "deny")
+    cold_rps = n_requests / max(cold_wall, 1e-9)
+    hot_rps = n_requests / max(hot_wall, 1e-9)
+    speedup = hot_rps / max(cold_rps, 1e-9)
+    answered = vc["hits_total"] + vc["misses_total"]
+    dedup = vc["window_dedup_rows"]
+    verdict = {
+        "req_per_s_uncached": round(cold_rps, 1),
+        "req_per_s_effective": round(hot_rps, 1),
+        "speedup": round(speedup, 3),
+        "required": ratio,
+        "requests": n_requests,
+        "pool": pool,
+        "conns": conns,
+        "depth": depth,
+        "verdicts_identical": identical,
+        "blocked": blocked,
+        "cache_hit_rate": round(vc["hits_total"] / answered, 4) if answered else 0.0,
+        "cache_entries": vc["entries"],
+        "window_dedup_rows": dedup,
+        "window_dedup_factor": round(
+            vc["misses_total"] / max(vc["misses_total"] - dedup, 1), 2
+        ),
+        "cpus": os.cpu_count(),
+        "single_core_degraded_gate": single_core and not ratio_explicit,
+    }
+    ok = speedup >= ratio and identical and blocked > 0
+    verdict["smoke"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,8 +332,10 @@ def test_deadline_header_falls_back_when_device_misses_it(monkeypatch):
     sc.start()
     try:
         # Wedge the device path: futures never resolve.
-        sc.batcher.submit = lambda request, tenant=None, span=None, lane=None: (
-            Future()
+        sc.batcher.submit = (
+            lambda request, tenant=None, span=None, lane=None, no_cache=False: (
+                Future()
+            )
         )
         t0 = time.monotonic()
         status, _, _ = _http(
