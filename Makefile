@@ -91,6 +91,10 @@ trace.smoke:  ## Flight-recorder gate: sampling off vs on within 5% req/s, compl
 extproc.smoke:  ## Envoy e2e gate: ftw corpus through a real Envoy -> ext_proc, verdicts bit-identical to the HTTP frontend. Loud skip when no Envoy binary.
 	$(PYTHON) hack/extproc_smoke.py
 
+.PHONY: automata.smoke
+automata.smoke:  ## Two-level automata gate: ftw+crs-lite replay on vs off, byte-identical verdicts, dfa-hot + prefiltered tiers exercised, Pallas interpret parity on CPU.
+	$(PYTHON) hack/automata_smoke.py
+
 .PHONY: metrics.lint
 metrics.lint:  ## Metric catalog drift: every registered cko_*/waf_* metric documented, no dead doc entries.
 	$(PYTHON) hack/metrics_lint.py

@@ -81,6 +81,42 @@ def _dedup_stats(tiers, n_req: int) -> dict:
     }
 
 
+def _automata_breakdown(eng) -> dict:
+    """Per-config two-level automata breakdown (docs/AUTOMATA.md): which
+    tier each match group landed on, how many device banks each tier
+    produced, and the prefilter's runtime economics — hit rate (how often
+    the approximate automata fired per examined row-column) and confirm
+    rate (how many of those the exact DFA upheld; the complement is the
+    over-approximation cost). With CKO_TIER_TIMING=1 the engine also
+    records per-stage p50 wall ms (match:<shape> / post)."""
+    summary = eng.automata_summary()
+    tiers = summary.get("tiers", {})
+    pf = summary.get("prefilter", {})
+    rows = int(pf.get("rows", 0))
+    hits = int(pf.get("hits", 0))
+    out = {
+        "enabled": summary.get("enabled", False),
+        "dfa_groups": int(tiers.get("dfa-hot", 0)),
+        "nfa_groups": int(tiers.get("nfa", 0)),
+        "prefiltered_groups": int(tiers.get("prefiltered", 0)),
+        "segment_groups": int(tiers.get("segment", 0)),
+        "gather_banks": summary.get("gather_banks", 0),
+        "pre_banks": summary.get("pre_banks", 0),
+        "prefilter_hits": hits,
+        "prefilter_confirms": int(pf.get("confirms", 0)),
+        "prefilter_false_positives": int(pf.get("false_positives", 0)),
+        "prefilter_hit_rate": round(hits / rows, 6) if rows else None,
+        "prefilter_confirm_rate": (
+            round(int(pf.get("confirms", 0)) / hits, 4) if hits else None
+        ),
+    }
+    if "tier_p50_ms" in summary:
+        out["tier_p50_ms"] = {
+            k: round(v, 3) for k, v in summary["tier_p50_ms"].items()
+        }
+    return out
+
+
 def _bench_match_fn(
     model, data, lengths, variant_data, variant_lengths, mask=None, n_chunks=1
 ):
@@ -424,7 +460,9 @@ def _config_1(iters, n_chunks):
             f'"id:{1000 + i},phase:2,deny,status:403"'
         )
     eng = WafEngine("\n".join(rules))
-    return _serve_throughput(eng, 4096, iters, n_chunks, measure_warm=True)
+    res = _serve_throughput(eng, 4096, iters, n_chunks, measure_warm=True)
+    res["automata"] = _automata_breakdown(eng)
+    return res
 
 
 def _config_2(iters, n_chunks):
@@ -468,6 +506,7 @@ def _config_2(iters, n_chunks):
     )
     res["ruleset_source"] = "crs-lite REQUEST-942 + setup"
     res["ftw_attack_stages"] = len(attacks)
+    res["automata"] = _automata_breakdown(eng)
     return res
 
 
@@ -551,6 +590,7 @@ def _config_3(iters, n_chunks, n_rules):
     res["seg_groups"] = sum(s.n_groups for s in eng.model.segs)
     res["ruleset_source"] = f"crs-lite + {pad} crs-grade synthetic @rx"
     res["ftw_attack_stages"] = n_attacks
+    res["automata"] = _automata_breakdown(eng)
     # Stream the device headline BEFORE the pipelined pass: if the
     # pipelined block's warm compile blows the wall budget, the kill
     # costs only that block, never the graded number.
@@ -677,6 +717,7 @@ def _config_4(iters, n_rules_full, n_rules_xl, batch_xl):
     n_chunks = max(1, batch_xl // chunk)
     res = _serve_throughput(eng, chunk, iters, n_chunks)
     res["rules_compiled"] = eng.compiled.n_rules
+    res["automata"] = _automata_breakdown(eng)
     res["effective_batch"] = chunk * n_chunks
     spec_xl = 5000
     if n_rules_xl < spec_xl:

@@ -25,6 +25,10 @@ CKO-R007 warn     rule skipped from the device plan (runs nowhere)
 CKO-R008 error    Seclang parse error
 CKO-R009 error    compile error (document not lowerable)
 CKO-R010 info     TPU-coverage summary (skip/approximate aggregation)
+                  + per-group automata-tier assignment (segment /
+                  dfa-hot / prefiltered / nfa)
+CKO-R011 info     group ineligible for the approximate prefilter (stays
+                  on the full-width NFA-derived tables) and why
 ======== ======== =====================================================
 """
 
@@ -48,6 +52,7 @@ from ..compiler.ruleset import (
     CompileError,
     compile_program,
 )
+from ..compiler.automata_plan import plan_automata
 from ..seclang.ast import RuleSetProgram, SeclangParseError
 from ..seclang.parser import parse
 from .findings import SEV_ERROR, SEV_INFO, SEV_WARN, AnalysisReport, Finding
@@ -349,6 +354,14 @@ def _coverage(program: RuleSetProgram, compiled: CompiledRuleSet, report: Analys
     approx_hist = Counter(_normalize_reason(reason) for _, reason in crep.approximations)
     denom = max(1, len(device_ids | skipped_ids))
     pct = 100.0 * len(device_ids) / denom
+    # Two-level automata tier assignment (compiler/automata_plan.py),
+    # evaluated with every tier force-enabled so the lint verdict states
+    # the document's INTRINSIC eligibility — not whatever CKO_AUTOMATA*
+    # knobs happen to be set in the analyzer's environment.
+    plan = plan_automata(
+        compiled, enabled=True, hot_enabled=True, prefilter_enabled=True
+    )
+    tier_counts = plan.counts()
     report.coverage = {
         "total_rules": total,
         "device_rules": len(device_ids),
@@ -358,6 +371,8 @@ def _coverage(program: RuleSetProgram, compiled: CompiledRuleSet, report: Analys
         "coverage_pct": round(pct, 2),
         "skip_reasons": dict(sorted(skip_hist.items())),
         "approximate_reasons": dict(sorted(approx_hist.items())),
+        "tier_assignment": tier_counts,
+        "prefilter_ineligible": len(plan.ineligible()),
     }
     for rid, reason in crep.skipped:
         report.add(
@@ -376,10 +391,40 @@ def _coverage(program: RuleSetProgram, compiled: CompiledRuleSet, report: Analys
             message=(
                 f"tpu coverage {pct:.1f}%: {len(device_ids)} rules on-device, "
                 f"{len(skipped_ids)} skipped, {len(approx_ids)} approximated, "
-                f"{crep.const_eliminated} const-eliminated"
+                f"{crep.const_eliminated} const-eliminated; automata tiers: "
+                f"{tier_counts['segment']} segment, "
+                f"{tier_counts['dfa-hot']} dfa-hot, "
+                f"{tier_counts['prefiltered']} prefiltered, "
+                f"{tier_counts['nfa']} nfa"
             ),
         )
     )
+    # CKO-R011: big groups the approximate prefilter could not cover —
+    # they stay on the full-width dense tables, the slowest device tier.
+    # Advisory only: verdicts are unaffected; this is a perf signal for
+    # rule authors (usually a pattern whose merged automaton blows up
+    # under subset construction at every width).
+    gid_rules: dict[int, set] = {}
+    for rule in compiled.rules:
+        for lid in rule.link_ids:
+            gid = compiled.links[lid].group
+            if gid >= 0:
+                gid_rules.setdefault(gid, set()).add(rule.rule_id)
+    for tier in plan.ineligible():
+        rids = sorted(gid_rules.get(tier.gid, ()))
+        report.add(
+            Finding(
+                code="CKO-R011",
+                severity=SEV_INFO,
+                rule_id=rids[0] if rids else None,
+                message=(
+                    f"group {tier.gid} ({tier.n_states} DFA states, rules "
+                    f"{rids or '[]'}) is ineligible for the approximate "
+                    f"prefilter: {tier.reason or 'no approximation found'}"
+                ),
+                detail=tier.reason,
+            )
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -68,6 +68,18 @@ class TierCompiler:
         # (label, cost) in submission order — smallest-first is the
         # contract (tests/test_lazy_tiers.py pins it).
         self.submitted: list[tuple[str, float]] = []
+        # label -> free-form metadata annotated at tier-selection time
+        # (engine startup stamps the automata composition here so stats
+        # and bench can say WHAT each compiled stage contains — e.g. how
+        # many dfa-hot gather banks rode into the matcher trace).
+        self.meta: dict[str, dict] = {}
+
+    def annotate(self, label: str, **meta) -> None:
+        """Attach/merge metadata onto a stage label. Purely descriptive:
+        never keys the executable cache, only surfaces through
+        ``stats()``-adjacent reporting."""
+        with self._lock:
+            self.meta.setdefault(label, {}).update(meta)
 
     def _ensure_pool(self) -> ThreadPoolExecutor:
         if self._pool is None:

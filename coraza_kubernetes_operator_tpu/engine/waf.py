@@ -387,7 +387,19 @@ class WafEngine:
 
     def __init__(self, rules: str | CompiledRuleSet):
         self.compiled = rules if isinstance(rules, CompiledRuleSet) else compile_rules(rules)
-        self.model: WafModel = build_model(self.compiled)
+        # Two-level automata plan (compiler/automata_plan.py): classifies
+        # every group into segment / dfa-hot / prefiltered / nfa under
+        # the CKO_AUTOMATA* knobs. build_model routes the hot groups to
+        # joint-byte-class gather banks and replaces prefiltered groups'
+        # device tables with their over-approximating automata; this
+        # engine's dispatch then confirms prefilter positives against the
+        # exact DFAs (_confirm_prefilter) so verdicts never change.
+        # Direct build_model(crs) callers (tests, the sharded mesh) get
+        # the plan-free exact layout.
+        from ..compiler.automata_plan import plan_automata
+
+        self.automata_plan = plan_automata(self.compiled)
+        self.model: WafModel = build_model(self.compiled, automata=self.automata_plan)
         self.extractor = TargetExtractor(self.compiled)
         self._n_real_rules = len(self.compiled.rules)  # model pads to ≥1 row
         self._rule_ids = np.asarray(
@@ -482,8 +494,11 @@ class WafEngine:
         # disables).
         from .value_cache import ValueHitCache
 
-        g_total = sum(s.n_groups for s in self.model.segs) + sum(
-            b.n_groups for b in self.model.banks
+        g_total = (
+            sum(s.n_groups for s in self.model.segs)
+            + sum(b.n_groups for b in self.model.banks)
+            + sum(b.n_groups for b in self.model.gather_banks)
+            + sum(b.n_groups for b in self.model.pre_banks)
         )
         cache_mb = int(_os.environ.get("CKO_VALUE_CACHE_MB", "256"))
         self.value_cache = (
@@ -515,6 +530,40 @@ class WafEngine:
         self._block_group_counts = tuple(
             [s.n_groups for s in self.model.segs]
             + [b.n_groups for b in self.model.banks]
+            + [b.n_groups for b in self.model.gather_banks]
+            + [b.n_groups for b in self.model.pre_banks]
+        )
+        # Prefilter confirmation counters (metrics/stats): hits = device
+        # prefilter positives seen, confirms = positives the exact DFA
+        # upheld, false_positives = positives it cleared. Guarded by a
+        # lock — the batcher dispatches windows from multiple lanes.
+        self.prefilter_stats = {
+            "rows": 0,  # (row x prefiltered-column) opportunities examined
+            "hits": 0,
+            "confirms": 0,
+            "false_positives": 0,
+        }
+        self._prefilter_lock = threading.Lock()
+        # Per-tier stage timing (CKO_TIER_TIMING=1): label -> recent wall
+        # seconds per dispatch (device sync per stage — costs pipelining,
+        # so it is bench/debug-only). bench.py turns these into per-tier
+        # p50s; /waf/v1/stats exposes them under the automata block.
+        self._tier_timing_on = _os.environ.get("CKO_TIER_TIMING", "0") == "1"
+        self.tier_timing: dict[str, list[float]] = {}
+        # Stamp the automata composition onto the matcher stage label at
+        # tier-selection time: tier stats / bench can then report what
+        # the compiled matchers actually contain, not just their shapes.
+        from .tier_compile import TIER_COMPILER
+
+        _counts = self.automata_plan.counts()
+        TIER_COMPILER.annotate(
+            "match",
+            segment_groups=_counts["segment"],
+            dfa_hot_groups=_counts["dfa-hot"],
+            prefiltered_groups=_counts["prefiltered"],
+            nfa_groups=_counts["nfa"],
+            gather_banks=len(self.model.gather_banks),
+            pre_banks=len(self.model.pre_banks),
         )
         # Host fallback evaluator (degraded-mode serving): built lazily on
         # first use — pure NumPy over the same compiled tables, so it can
@@ -541,7 +590,7 @@ class WafEngine:
         device batch re-proves the path before promotion — executables
         are re-fetched from the process/persistent compile caches, so the
         re-put costs array transfers, not XLA compiles."""
-        self.model = build_model(self.compiled)
+        self.model = build_model(self.compiled, automata=self.automata_plan)
         self.warmed = False
 
     @property
@@ -955,20 +1004,31 @@ class WafEngine:
             TIER_COMPILER.compile_all(specs)
         device = True
         tier_hits = []
+        from_device = []
         for spec, tier, mask in zip(match_specs, tiers, masks):
             if not self._lazy or TIER_COMPILER.resident(spec):
-                _label, _cost, fn, fargs, statics, dyn = spec
-                tier_hits.append(EXEC_CACHE.call(fn, fargs, statics, dyn))
+                label, _cost, fn, fargs, statics, dyn = spec
+                tier_hits.append(self._timed_call(label, fn, fargs, statics, dyn))
+                from_device.append(True)
             else:
                 device = False
                 tier_hits.append(self._host_tier_hits(tier, mask))
+                from_device.append(False)
         tier_hits = tuple(tier_hits)
+        # Prefilter confirm (two-level automata): device matcher rows for
+        # prefiltered groups are OVER-approximate — re-check positives
+        # against the exact DFAs and clear the false ones before anything
+        # downstream (post stage, value-cache insert, host post) reads
+        # the bits. Host-twin rows are already exact and are skipped.
+        if self.model.prefilter_cols:
+            tier_hits = self._confirm_prefilter(tier_hits, tiers, from_device)
         # The post stage takes packed hit rows from EITHER provenance —
         # device matcher output or host-computed numpy — at identical
         # shapes/bit layout, so a mixed window still shares the one post
         # executable.
         if not self._lazy or TIER_COMPILER.resident(post_spec):
-            packed = EXEC_CACHE.call(
+            packed = self._timed_call(
+                "post",
                 eval_post_tiered,
                 (self.model, tier_hits, pairs, numvals),
                 {"max_phase": max_phase},
@@ -986,6 +1046,118 @@ class WafEngine:
             cache_pop=cached is not None,
             device=device,
         )
+
+    def _timed_call(self, label: str, fn, fargs, statics, dyn):
+        """EXEC_CACHE.call, optionally wall-timed per stage label when
+        CKO_TIER_TIMING=1. Timing blocks on device completion per stage
+        (costs pipelining), so it is a bench/debug knob, never the
+        serving default."""
+        from .compile_cache import EXEC_CACHE
+
+        if not self._tier_timing_on:
+            return EXEC_CACHE.call(fn, fargs, statics, dyn)
+        t0 = time.perf_counter()
+        out = EXEC_CACHE.call(fn, fargs, statics, dyn)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        with self._prefilter_lock:
+            buf = self.tier_timing.setdefault(label, [])
+            buf.append(dt)
+            if len(buf) > 512:
+                del buf[: len(buf) - 512]
+        return out
+
+    def _confirm_prefilter(self, tier_hits, tiers, from_device):
+        """Confirm device prefilter positives against the exact DFAs.
+
+        The pre-bank columns (``model.prefilter_cols``: (device column,
+        gid) pairs) carry verdicts of the APPROXIMATE automata — sound
+        over-approximations, so a 0 is final but a 1 may be spurious.
+        For every positive row, run the exact ``DFA.search`` on the same
+        transformed bytes the device saw (variant-buffer row when the
+        pipeline has a host slot, ``apply_pipeline`` otherwise — the
+        ``_host_tier_hits`` convention) and clear the bit unless it
+        confirms. Patched rows re-pack to numpy; the post stage accepts
+        either provenance, and the value cache then stores EXACT bits, so
+        cached replays skip both the matcher and the confirm.
+
+        Host-twin entries (``from_device`` False) computed exact hits
+        already and pass through untouched."""
+        g = int(self.model.e_lg.shape[0])
+        cols = self.model.prefilter_cols
+        n_rows = n_hits = n_confirms = 0
+        out = list(tier_hits)
+        for ti, (hp, tier, dev) in enumerate(zip(tier_hits, tiers, from_device)):
+            if not dev:
+                continue
+            packed = np.asarray(jax.device_get(hp))
+            hits = np.unpackbits(packed, axis=1, count=g).astype(bool)
+            n_rows += hits.shape[0] * len(cols)
+            d = lg = vd = vl = None
+            val_cache: dict[tuple[int, int], bytes] = {}
+            changed = False
+            for col, gid in cols:
+                rows = np.flatnonzero(hits[:, col])
+                if rows.size == 0:
+                    continue
+                n_hits += int(rows.size)
+                if d is None:
+                    d = np.asarray(tier[0])
+                    lg = np.asarray(tier[1])
+                    vd = np.asarray(tier[6])
+                    vl = np.asarray(tier[7])
+                pid = self.compiled.group_pipeline[gid]
+                slot = int(self.model.host_variant_index[pid])
+                dfa = self.compiled.groups[gid].dfa
+                for i in rows:
+                    i = int(i)
+                    val = val_cache.get((pid, i))
+                    if val is None:
+                        if slot >= 0:
+                            val = vd[slot, i, : vl[slot, i]].tobytes()
+                        else:
+                            names = list(self.compiled.pipelines[pid])
+                            val = apply_pipeline(d[i, : lg[i]].tobytes(), names)
+                        val_cache[(pid, i)] = val
+                    if dfa.search(val):
+                        n_confirms += 1
+                    else:
+                        hits[i, col] = False
+                        changed = True
+            if changed:
+                out[ti] = np.packbits(hits, axis=1)
+        if n_rows:
+            with self._prefilter_lock:
+                self.prefilter_stats["rows"] += n_rows
+                self.prefilter_stats["hits"] += n_hits
+                self.prefilter_stats["confirms"] += n_confirms
+                self.prefilter_stats["false_positives"] += n_hits - n_confirms
+        return tuple(out)
+
+    def automata_summary(self) -> dict:
+        """Automata-tier composition + prefilter counters for stats,
+        metrics, and bench: which groups run where (the plan's verdict),
+        how many device banks each tier produced, and how the prefilter's
+        over-approximation is paying off at runtime."""
+        plan = self.automata_plan
+        counts = plan.counts()
+        with self._prefilter_lock:
+            pstats = dict(self.prefilter_stats)
+            timing = {
+                label: sorted(buf)[len(buf) // 2] * 1000.0
+                for label, buf in self.tier_timing.items()
+                if buf
+            }
+        summary = {
+            "enabled": plan.enabled,
+            "tiers": counts,
+            "gather_banks": len(self.model.gather_banks),
+            "pre_banks": len(self.model.pre_banks),
+            "prefilter": pstats,
+        }
+        if timing:
+            summary["tier_p50_ms"] = timing
+        return summary
 
     # -- host twins for not-yet-compiled stages (lazy tier compilation) ------
 

@@ -40,6 +40,7 @@ from ..compiler.ruleset import (
 )
 from ..compiler.segments import plan_segments
 from ..ops.dfa import DFABank, stack_dfas
+from ..ops.dfa_gather import GatherBank, plan_gather_bins, stack_gather_bank
 from ..ops.segment import SegmentBlock, build_segment_block, match_segment_block
 from ..ops.transforms import apply_device_pipeline
 
@@ -138,6 +139,17 @@ class WafModel:
     # with a few fused VMEM-resident scans; covered banks' legacy scans
     # are skipped in match_tier. Empty when fusion is disabled.
     flat_banks: list = field(default_factory=list)
+    # Two-level automata (ops/dfa_gather.py, compiler/automata_plan.py).
+    # DFA hot tier: joint-byte-class packed gather banks for the plan's
+    # "dfa-hot" groups. Empty unless build_model was handed a plan.
+    gather_banks: list = field(default_factory=list)
+    # Approximate prefilter: stacked OVER-APPROXIMATING automata fronting
+    # the plan's "prefiltered" groups. Their hit columns may over-match
+    # by design — the engine's dispatch confirms positive rows against
+    # the exact automata on the host (prefilter_cols below) before the
+    # post stage, so verdicts never change. A model with non-empty
+    # pre_banks must only be evaluated through that confirm path.
+    pre_banks: list = field(default_factory=list)
     # static metadata
     bank_pipelines: tuple = field(default_factory=tuple)  # pipeline id per bank
     seg_pipelines: tuple = field(default_factory=tuple)  # pipeline id per seg block
@@ -173,6 +185,15 @@ class WafModel:
     # to permute them back for the host post-match. Canonicalized out of
     # the aux like block_kinds/block_cost — never read in a trace.
     group_order: tuple = ()
+    # Pipeline id per gather / prefilter bank (trace statics, mirror
+    # bank_pipelines).
+    gather_bank_pipelines: tuple = field(default_factory=tuple)
+    pre_bank_pipelines: tuple = field(default_factory=tuple)
+    # Host-side only: (device hit column, original group id) per
+    # prefiltered group — the engine's confirm step re-checks positive
+    # rows of these columns against the exact DFA. Canonicalized out of
+    # the aux like group_order — never read in a trace.
+    prefilter_cols: tuple = ()
 
     def tree_flatten(self):
         leaves = (
@@ -204,6 +225,8 @@ class WafModel:
             self.long_banks,
             self.seg_perm,
             self.flat_banks,
+            self.gather_banks,
+            self.pre_banks,
         )
         # CANONICAL aux (shape-canonical executable reuse): the aux tuple
         # is the jit/AOT cache key's treedef component, so it must contain
@@ -230,6 +253,9 @@ class WafModel:
             self.two_pass_counters,
             self.flat_covered,
             (),  # group_order: host-side only, canonicalized out
+            self.gather_bank_pipelines,
+            self.pre_bank_pipelines,
+            (),  # prefilter_cols: host-side only, canonicalized out
         )
         return leaves, aux
 
@@ -255,7 +281,7 @@ def lgroup_onehot(lgroup: np.ndarray, n_groups: int) -> np.ndarray:
     return e_lg
 
 
-def build_model(crs: CompiledRuleSet) -> WafModel:
+def build_model(crs: CompiledRuleSet, automata=None) -> WafModel:
     """Lay out a CompiledRuleSet as device arrays. Groups are re-ordered so
     each bank's groups are contiguous; links are rewritten accordingly.
 
@@ -263,14 +289,43 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
     (``compiler/segments.py``) — those match on the MXU conv tier; the
     rest bucket into DFA banks by state count. Global group order (and the
     lgroup remap) is: segment blocks sorted by pipeline id, then DFA
-    buckets sorted by (pipeline, bucket)."""
+    buckets sorted by (pipeline, bucket), then gather banks, then
+    prefilter banks.
+
+    ``automata`` (``compiler/automata_plan.AutomataPlan`` or None) turns
+    on the two-level automata layout: the plan's "dfa-hot" groups leave
+    the generic banks for joint-byte-class ``GatherBank``s and its
+    "prefiltered" groups are REPLACED on device by their small
+    over-approximating automata (``pre_banks`` + ``prefilter_cols``).
+    The default (None) keeps every group exact — direct ``eval_waf*``
+    callers and the sharded path (``parallel/mesh.py``) never see an
+    approximate column; only ``engine.waf.WafEngine`` passes a plan, and
+    its dispatch confirms prefilter positives before the post stage."""
     seg_groups: dict[int, list[tuple[int, object]]] = {}
     buckets: dict[tuple[int, int], list[int]] = {}
+    hot_buckets: dict[tuple[int, int], list[int]] = {}
+    pre_buckets: dict[tuple[int, int], list[int]] = {}
+    approx_of: dict[int, object] = {}
+    tier_of = (
+        {t.gid: t for t in automata.tiers} if automata is not None else {}
+    )
     for gid, grp in enumerate(crs.groups):
         pid = crs.group_pipeline[gid]
         plan = plan_segments(grp.dfa.ast)
         if plan is not None:
             seg_groups.setdefault(pid, []).append((gid, plan))
+            continue
+        entry = tier_of.get(gid)
+        if entry is not None and entry.kind == "dfa-hot":
+            hot_buckets.setdefault(
+                (pid, _state_bucket(grp.dfa.n_states)), []
+            ).append(gid)
+            continue
+        if entry is not None and entry.kind == "prefiltered" and entry.approx is not None:
+            approx_of[gid] = entry.approx
+            pre_buckets.setdefault(
+                (pid, _state_bucket(entry.approx.n_states)), []
+            ).append(gid)
             continue
         buckets.setdefault((pid, _state_bucket(grp.dfa.n_states)), []).append(gid)
 
@@ -294,6 +349,41 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         bank_pipelines.append(pid)
         bank_gids.append(list(gids))
         for g in gids:
+            remap[g] = next_new
+            next_new += 1
+
+    # DFA hot tier: joint-byte-class gather banks. Within a (pipeline,
+    # bucket) population the greedy packer splits members into bins so
+    # each bank's joint class count and VMEM working set stay under the
+    # kernel caps; one bin == one GatherBank == one maskable block.
+    gather_banks: list[GatherBank] = []
+    gather_bank_pipelines: list[int] = []
+    gather_bank_gids: list[list[int]] = []
+    for (pid, _bucket), gids in sorted(hot_buckets.items()):
+        dfas = [crs.groups[g].dfa for g in gids]
+        for bin_ in plan_gather_bins(dfas):
+            members = [gids[i] for i in bin_]
+            gather_banks.append(stack_gather_bank([crs.groups[g].dfa for g in members]))
+            gather_bank_pipelines.append(pid)
+            gather_bank_gids.append(members)
+            for g in members:
+                remap[g] = next_new
+                next_new += 1
+
+    # Approximate prefilter banks: the plan's over-approximating automata
+    # stacked like ordinary (small => dense fast path) banks. Their
+    # columns over-match by design; prefilter_cols records which device
+    # columns need the engine's exact host confirm.
+    pre_banks: list[DFABank] = []
+    pre_bank_pipelines: list[int] = []
+    pre_bank_gids: list[list[int]] = []
+    prefilter_cols: list[tuple[int, int]] = []
+    for (pid, _bucket), gids in sorted(pre_buckets.items()):
+        pre_banks.append(stack_dfas([approx_of[g] for g in gids]))
+        pre_bank_pipelines.append(pid)
+        pre_bank_gids.append(list(gids))
+        for g in gids:
+            prefilter_cols.append((next_new, g))
             remap[g] = next_new
             next_new += 1
 
@@ -487,6 +577,24 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
             block_cost.append(0.5 * s * max(g, 128))  # VMEM-resident MXU scan
         else:
             block_cost.append(8.0 * s * g)  # HBM take-scan
+    for members in gather_bank_gids:
+        ks = set()
+        for gid in members:
+            ks |= gkind_sets[gid]
+        block_kinds.append(tuple(sorted(ks)))
+    for gb in gather_banks:
+        # Joint-class packing shrinks the resident table and the dominant
+        # per-step contraction by 256/C vs the byte-indexed dense scan.
+        factor = max(0.1, gb.n_classes / 256.0)
+        block_cost.append(0.5 * factor * gb.n_states * max(gb.n_groups, 128))
+    for members in pre_bank_gids:
+        ks = set()
+        for gid in members:
+            ks |= gkind_sets[gid]
+        block_kinds.append(tuple(sorted(ks)))
+    for pb in pre_banks:
+        s, g = pb.n_states, pb.n_groups
+        block_cost.append(0.5 * s * max(g, 128))  # small dense approx bank
     # Inverse of remap: original group id per device hit column (host
     # metadata for the lazy host-tier path — see WafModel.group_order).
     n_g = len(crs.groups)
@@ -532,6 +640,8 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         long_banks=long_banks,
         seg_perm=seg_perm,
         flat_banks=flat_banks_built,
+        gather_banks=gather_banks,
+        pre_banks=pre_banks,
         bank_pipelines=tuple(bank_pipelines),
         seg_pipelines=tuple(seg_pipelines),
         long_bank_pipelines=tuple(long_bank_pipelines),
@@ -547,6 +657,9 @@ def build_model(crs: CompiledRuleSet) -> WafModel:
         two_pass_counters=two_pass_counters,
         flat_covered=tuple(sorted(flat_covered)),
         group_order=group_order,
+        gather_bank_pipelines=tuple(gather_bank_pipelines),
+        pre_bank_pipelines=tuple(pre_bank_pipelines),
+        prefilter_cols=tuple(prefilter_cols),
     )
 
 
@@ -791,6 +904,28 @@ def match_tier(
             continue
         tdata, tlen = transformed_for(pid)
         per_block.append(scan_dfa_bank(bank, tdata, tlen))
+    # Two-level automata blocks (after the generic banks in the global
+    # column order): DFA hot-tier gather banks, then the approximate
+    # prefilter banks (whose columns the engine confirms on the host).
+    n_banks = len(model.banks)
+    if model.gather_banks:
+        from ..ops.dfa_gather import scan_gather_bank
+
+        for gi, (gb, pid) in enumerate(
+            zip(model.gather_banks, model.gather_bank_pipelines)
+        ):
+            if not block_on(n_segs + n_banks + gi):
+                per_block.append(
+                    jnp.zeros((data.shape[0], gb.n_groups), dtype=bool)
+                )
+                continue
+            per_block.append(scan_gather_bank(gb, *transformed_for(pid)))
+    n_gather = len(model.gather_banks)
+    for pi, (pb, pid) in enumerate(zip(model.pre_banks, model.pre_bank_pipelines)):
+        if not block_on(n_segs + n_banks + n_gather + pi):
+            per_block.append(jnp.zeros((data.shape[0], pb.n_groups), dtype=bool))
+            continue
+        per_block.append(scan_dfa_bank(pb, *transformed_for(pid)))
     if per_block:
         return jnp.concatenate(per_block, axis=1)  # [T, G]
     return jnp.zeros((data.shape[0], 1), dtype=bool)

@@ -4,6 +4,8 @@
   ``[batch, len]`` uint8 tensors.
 - ``dfa`` — the core matcher: blockwise ``lax.scan`` over stacked
   byte-class DFA tables (two gathers per byte per rule-group).
+- ``dfa_gather`` — the DFA hot tier: joint-byte-class packed
+  transition-gather banks for small/safe groups (docs/AUTOMATA.md).
 - ``pallas`` — hand-written TPU kernels for the hot paths.
 
 All kernels are shape-static and jit-safe: control flow is ``lax.scan``/
@@ -11,3 +13,9 @@ All kernels are shape-static and jit-safe: control flow is ``lax.scan``/
 """
 
 from .dfa import DFABank, scan_dfa_bank, stack_dfas  # noqa: F401
+from .dfa_gather import (  # noqa: F401
+    GatherBank,
+    plan_gather_bins,
+    scan_gather_bank,
+    stack_gather_bank,
+)

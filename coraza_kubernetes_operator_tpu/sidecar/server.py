@@ -1132,6 +1132,38 @@ class TpuEngineSidecar:
             "Cumulative XLA compile seconds per tier executable label",
             ("tier",),
         )
+        # -- two-level device automata (docs/AUTOMATA.md) -------------------
+        # Plan composition + prefilter confirm counters for the default
+        # tenant's engine, sampled at render time (hot reloads swap the
+        # engine — and its plan — under us).
+        self.metrics.gauge(
+            "cko_dfa_hot_groups",
+            "Groups compiled to DFA-hot transition-gather banks"
+            " (default tenant)",
+        ).set_function(lambda: float(self._automata_count("dfa-hot")))
+        m_tier_kind = self.metrics.gauge(
+            "cko_tier_kind",
+            "Match groups by automata tier assignment (default tenant)",
+            ("kind",),
+        )
+        for kind in ("segment", "dfa-hot", "prefiltered", "nfa"):
+            m_tier_kind.set_function(
+                (lambda k: lambda: float(self._automata_count(k)))(kind),
+                kind=kind,
+            )
+        self.metrics.gauge(
+            "cko_prefilter_hits_total",
+            "Device prefilter positives routed to exact confirmation",
+        ).set_function(lambda: float(self._prefilter_stat("hits")))
+        self.metrics.gauge(
+            "cko_prefilter_confirms_total",
+            "Prefilter positives the exact DFA confirmed",
+        ).set_function(lambda: float(self._prefilter_stat("confirms")))
+        self.metrics.gauge(
+            "cko_prefilter_false_positives_total",
+            "Prefilter positives the exact DFA cleared (over-approximation"
+            " cost)",
+        ).set_function(lambda: float(self._prefilter_stat("false_positives")))
         self.batcher.on_engine_error = (
             lambda _engine, err: self.degraded.record_device_failure(err)
         )
@@ -2477,6 +2509,21 @@ class TpuEngineSidecar:
             return 0
         return int(getattr(engine.compiled.report, field, 0))
 
+    def _automata_summary(self) -> dict:
+        """The default tenant's two-level automata summary (tier counts,
+        bank counts, prefilter confirm counters; docs/AUTOMATA.md), or a
+        disabled stub while no engine is resident."""
+        engine = self.tenants.engine_for(None)
+        if engine is None or not hasattr(engine, "automata_summary"):
+            return {"enabled": False, "tiers": {}, "prefilter": {}}
+        return engine.automata_summary()
+
+    def _automata_count(self, kind: str) -> int:
+        return int(self._automata_summary()["tiers"].get(kind, 0))
+
+    def _prefilter_stat(self, key: str) -> int:
+        return int(self._automata_summary()["prefilter"].get(key, 0))
+
     def render_metrics(self) -> str:
         """Render /metrics, refreshing the per-tier compile-time gauge
         first (its label set grows as tier executables mint — labels
@@ -2553,6 +2600,7 @@ class TpuEngineSidecar:
             },
             "resident_engines": self.tenants.resident_engines(),
             "engine_dedup_hits": self.tenants.engine_dedup_hits,
+            "automata": self._automata_summary(),
             "analysis": {
                 "cko_analysis_findings_total": self.tenants.analysis_counts(),
                 "rejected_reloads": self.tenants.total_analyze_rejected,

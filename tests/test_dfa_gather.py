@@ -1,0 +1,200 @@
+"""DFA hot tier: transition-gather banks vs the scalar DFA oracle.
+
+Language-equivalence property tests for both formulations of the
+joint-byte-class gather path (docs/AUTOMATA.md): the jnp gather lowering
+and the Pallas kernel in ``interpret=True`` mode, over the shared regex
+corpus, sampled crs-lite hot-tier patterns, and fuzzed inputs. The
+oracle is ``DFA.search`` — the same scalar reference every other matcher
+path in this repo is tested against.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler import (
+    compile_regex_dfa,
+    literal_dfa,
+    pm_dfa,
+)
+from coraza_kubernetes_operator_tpu.compiler.re_dfa import (
+    joint_class_count,
+    joint_classmap,
+)
+from coraza_kubernetes_operator_tpu.ops import scan_dfa_bank, stack_dfas
+from coraza_kubernetes_operator_tpu.ops.dfa_gather import (
+    _MAX_JOINT_CLASSES,
+    plan_gather_bins,
+    scan_gather_bank,
+    scan_gather_bank_jnp,
+    stack_gather_bank,
+)
+from coraza_kubernetes_operator_tpu.ops.dfa_gather_pallas import (
+    scan_gather_bank_pallas,
+)
+
+PATTERNS = [
+    ("rx", r"(?i:(\b(select|union|insert|update|delete|drop)\b.*\b(from|into|where|table)\b))"),
+    ("rx", r"(?i:<script[^>]*>)"),
+    ("rx", "^/admin"),
+    ("rx", r"\bor\b\s*['\"]?\d+['\"]?\s*=\s*['\"]?\d+"),
+    ("rx", "passwd$"),
+    ("rx", "a*"),  # always-match
+    ("lit", b"evilmonkey"),
+    ("pm", [b"sleep", b"benchmark", b"waitfor"]),
+]
+
+CORPUS = [
+    b"",
+    b"GET /index.html",
+    b"/admin/panel",
+    b"x/admin",
+    b"select * from users",
+    b"SELECT a FROM b",
+    b"selections from x",
+    b"<script>alert(1)</script>",
+    b"benchmark(100)",
+    b"evilmonkey was here",
+    b"or 1=1",
+    b"for 1=1",
+    b"/etc/passwd",
+    b"passwd file",
+    b"a" * 80,
+]
+
+
+def _dfas():
+    out = []
+    for kind, arg in PATTERNS:
+        if kind == "rx":
+            out.append(compile_regex_dfa(arg))
+        elif kind == "lit":
+            out.append(literal_dfa(arg))
+        else:
+            out.append(pm_dfa(arg))
+    return out
+
+
+def _tensorize(cases, max_len=96):
+    n = len(cases)
+    data = np.zeros((n, max_len), dtype=np.uint8)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i, c in enumerate(cases):
+        c = c[:max_len]
+        data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        lengths[i] = len(c)
+    return jnp.asarray(data), jnp.asarray(lengths), max_len
+
+
+def _fuzz(n=120, seed=7, alphabet=b"abcdefor1=' <>script/untilfwm\x00\xff"):
+    rng = random.Random(seed)
+    return [
+        bytes(rng.choice(alphabet) for _ in range(rng.randrange(0, 70)))
+        for _ in range(n)
+    ]
+
+
+def test_joint_classmap_refines_members():
+    """The joint partition must distinguish every pair of bytes any
+    member distinguishes: member classmaps factor through it."""
+    dfas = _dfas()
+    classmap, remaps = joint_classmap(dfas)
+    assert classmap.shape == (256,)
+    assert int(classmap.max()) + 1 == joint_class_count(dfas)
+    for d, remap in zip(dfas, remaps):
+        assert (remap[classmap] == d.classmap).all()
+
+
+def test_jnp_gather_matches_oracle():
+    dfas = _dfas()
+    bank = stack_gather_bank(dfas)
+    cases = CORPUS + _fuzz()
+    data, lengths, max_len = _tensorize(cases)
+    got = np.asarray(scan_gather_bank_jnp(bank, data, lengths))
+    for i, c in enumerate(cases):
+        for g, dfa in enumerate(dfas):
+            assert got[i, g] == dfa.search(c[:max_len]), (c, PATTERNS[g])
+
+
+def test_pallas_interpret_kernel_matches_oracle():
+    """The exact kernel program the TPU runs, executed via
+    ``pallas_call(interpret=True)`` on CPU."""
+    dfas = _dfas()
+    bank = stack_gather_bank(dfas)
+    cases = CORPUS + _fuzz(seed=11)
+    data, lengths, max_len = _tensorize(cases)
+    got = np.asarray(
+        scan_gather_bank_pallas(
+            bank.tC,
+            bank.classmap,
+            bank.match_end.T,
+            bank.always,
+            data,
+            lengths,
+            s=bank.n_states,
+            g=bank.n_groups,
+            c=bank.n_classes,
+            interpret=True,
+        )
+    )
+    for i, c in enumerate(cases):
+        for g, dfa in enumerate(dfas):
+            assert got[i, g] == dfa.search(c[:max_len]), (c, PATTERNS[g])
+
+
+def test_dispatch_knobs(monkeypatch):
+    """CKO_PALLAS=0 forces the jnp lowering; CKO_PALLAS_INTERPRET=1
+    forces the interpret-mode kernel off-TPU. Both must agree with the
+    existing byte-indexed bank path on the same DFAs."""
+    dfas = _dfas()
+    gbank = stack_gather_bank(dfas)
+    dbank = stack_dfas(dfas)
+    cases = CORPUS + _fuzz(seed=3)
+    data, lengths, _ = _tensorize(cases)
+    ref = np.asarray(scan_dfa_bank(dbank, data, lengths))
+
+    monkeypatch.setenv("CKO_PALLAS", "0")
+    got_jnp = np.asarray(scan_gather_bank(gbank, data, lengths))
+    assert (got_jnp == ref).all()
+
+    monkeypatch.setenv("CKO_PALLAS", "1")
+    monkeypatch.setenv("CKO_PALLAS_INTERPRET", "1")
+    got_pl = np.asarray(scan_gather_bank(gbank, data, lengths))
+    assert (got_pl == ref).all()
+
+
+def test_plan_gather_bins_respects_class_cap():
+    dfas = _dfas()
+    bins = plan_gather_bins(dfas)
+    seen = sorted(i for b in bins for i in b)
+    assert seen == list(range(len(dfas)))  # every DFA placed exactly once
+    for bin_ in bins:
+        members = [dfas[i] for i in bin_]
+        assert joint_class_count(members) <= _MAX_JOINT_CLASSES
+
+
+@pytest.mark.slow
+def test_crs_lite_hot_groups_match_oracle():
+    """Sampled crs-lite hot-tier patterns: the gather bank agrees with
+    the scalar oracle on fuzzed traffic for the real CRS-shaped DFAs the
+    planner routes to this tier."""
+    from coraza_kubernetes_operator_tpu.compiler.automata_plan import plan_automata
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+
+    crs = compile_rules(load_ruleset_text())
+    plan = plan_automata(crs, enabled=True, hot_enabled=True)
+    hot = [t for t in plan.tiers if t.kind == "dfa-hot"][:8]
+    assert hot, "crs-lite must yield dfa-hot groups"
+    dfas = [crs.groups[t.gid].dfa for t in hot]
+    bank = stack_gather_bank(dfas)
+    cases = CORPUS + _fuzz(
+        n=80, seed=5, alphabet=b"abcdefghij <>=%'()/.;:&?-_0123456789"
+    )
+    data, lengths, max_len = _tensorize(cases, max_len=80)
+    got = np.asarray(scan_gather_bank_jnp(bank, data, lengths))
+    for i, c in enumerate(cases):
+        for g, dfa in enumerate(dfas):
+            assert got[i, g] == dfa.search(c[:max_len]), (c, hot[g].gid)

@@ -1,0 +1,111 @@
+"""Approximate prefilter soundness: NEVER a false negative.
+
+``compiler/re_approx.approx_dfa`` builds a lossy, state-merged automaton
+whose language must be a SUPERSET of the exact DFA's — the device may
+over-match (cleared by the engine's exact confirm step) but must never
+under-match, or verdicts would change. These property tests check that
+containment over the shared regex corpus, sampled crs-lite prefilter
+groups, and fuzzed inputs, plus the eligibility edge cases.
+"""
+
+import random
+
+import pytest
+
+from coraza_kubernetes_operator_tpu.compiler import compile_regex_dfa
+from coraza_kubernetes_operator_tpu.compiler.re_approx import approx_dfa
+
+# Patterns whose minimized DFAs land past the dense-table ceiling (the
+# prefilter's population): counted repetitions force state blowup.
+BIG_PATTERNS = [
+    r"(a|b)*a(a|b){7}c",  # classic exponential subset-construction shape
+    r"u(x|y){200}v",  # long counted chain
+    r"(?i:script[^>]{0,20}src)",  # CRS-ish bounded-gap keyword pair
+]
+
+
+def _fuzz(alphabet, n=400, max_len=80, seed=13):
+    rng = random.Random(seed)
+    return [
+        bytes(rng.choice(alphabet) for _ in range(rng.randrange(0, max_len)))
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("pattern", BIG_PATTERNS)
+def test_never_a_false_negative(pattern):
+    exact = compile_regex_dfa(pattern)
+    assert exact.n_states > 128, "corpus pattern must be prefilter-sized"
+    got = approx_dfa(exact)
+    assert got.dfa is not None, got.reason
+    approx = got.dfa
+    assert approx.n_states <= 128
+    # Dense alphabet biased toward the pattern's own letters so the fuzz
+    # actually reaches deep states.
+    alphabet = b"abuxyvscript<>=src 0123456789"
+    hits = 0
+    for case in _fuzz(alphabet):
+        if exact.search(case):
+            hits += 1
+            assert approx.search(case), (pattern, case)
+    # Positive-directed inputs: mutate known-matching strings.
+    seeds = {
+        r"(a|b)*a(a|b){7}c": b"a" + b"ab" * 4 + b"c",
+        r"u(x|y){200}v": b"u" + b"xy" * 100 + b"v",
+        r"(?i:script[^>]{0,20}src)": b"script--src",
+    }
+    seed = seeds[pattern]
+    assert exact.search(seed) and approx.search(seed)
+    rng = random.Random(29)
+    for _ in range(200):
+        mut = bytearray(seed)
+        for _ in range(rng.randrange(0, 3)):
+            mut[rng.randrange(len(mut))] = rng.choice(alphabet)
+        case = bytes(rng.choice(alphabet) for _ in range(rng.randrange(0, 10))) + bytes(mut)
+        if exact.search(case):
+            assert approx.search(case), (pattern, case)
+
+
+def test_always_match_is_ineligible():
+    got = approx_dfa(compile_regex_dfa("a*"))
+    assert got.dfa is None
+    assert "always match" in got.reason
+
+
+def test_small_exact_is_ineligible():
+    got = approx_dfa(compile_regex_dfa("abc"))
+    assert got.dfa is None
+    assert "already small" in got.reason
+
+
+def test_width_cap_respected():
+    exact = compile_regex_dfa(BIG_PATTERNS[0])
+    got = approx_dfa(exact, width=4)
+    if got.dfa is not None:
+        assert got.width <= 4
+        assert got.dfa.n_states <= 128
+
+
+@pytest.mark.slow
+def test_crs_lite_prefilter_groups_sound():
+    """Every group the planner prefilters on crs-lite: containment over
+    fuzzed request-ish bytes."""
+    from coraza_kubernetes_operator_tpu.compiler.automata_plan import plan_automata
+    from coraza_kubernetes_operator_tpu.compiler.ruleset import compile_rules
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+
+    crs = compile_rules(load_ruleset_text())
+    plan = plan_automata(crs, enabled=True, prefilter_enabled=True)
+    pre = [t for t in plan.tiers if t.kind == "prefiltered"]
+    assert pre, "crs-lite must yield prefiltered groups"
+    cases = _fuzz(
+        b"abcdefghij <>=%'()/.;:&?-_0123456789unionselectscriptetcpasswd",
+        n=250,
+        seed=17,
+    )
+    for tier in pre:
+        exact = crs.groups[tier.gid].dfa
+        approx = tier.approx
+        for case in cases:
+            if exact.search(case):
+                assert approx.search(case), (tier.gid, case)
