@@ -63,6 +63,11 @@ ingest.smoke:  ## Async frontend gate: async >= 2x threaded req/s, verdicts iden
 ingest.fuzz:  ## Seeded protocol fuzz: identical error taxonomy on both frontends, zero leaks.
 	$(PYTHON) hack/ingest_fuzz.py
 
+.PHONY: native.parity
+native.parity:  ## Native tiered-pipeline gate: fuzz + ftw corpora, bit-identical tensors and verdicts vs the Python fallback.
+	$(MAKE) native
+	$(PYTHON) hack/native_parity_smoke.py
+
 .PHONY: sched.smoke
 sched.smoke:  ## Adaptive scheduler gate: adaptive p99 <= best static delay, verdicts identical.
 	$(PYTHON) hack/sched_smoke.py

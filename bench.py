@@ -916,11 +916,20 @@ def _config_e2e(iters):
         non_200 = sum(1 for s in statuses if s not in (200, 403, 413))
         blocked = sum(1 for s in statuses if s in (403, 413))
 
+        host_s_before = sum(sc.batcher.stats.host_stage_s)
         walls = []
         while len(walls) < max(2, iters) and left() > warm_s * 1.5 + 10:
             statuses, wall = _e2e_drive(sc.port, payloads, conns, depth)
             non_200 += sum(1 for s in statuses if s not in (200, 403, 413))
             walls.append(wall)
+        # Host-assemble share of e2e wall over the timed passes (falls
+        # back to the warm pass when the budget allowed no timed pass).
+        if walls:
+            host_share = (
+                sum(sc.batcher.stats.host_stage_s) - host_s_before
+            ) / max(sum(walls), 1e-9)
+        else:
+            host_share = sum(sc.batcher.stats.host_stage_s) / max(warm_s, 1e-9)
         walls.sort()
         warm_only = not walls
         p50 = walls[len(walls) // 2] if walls else warm_s
@@ -968,6 +977,19 @@ def _config_e2e(iters):
         fe = sc.stats().get("frontend", {})
         req_per_s = round(n_requests / p50, 1)
         floor = float(os.environ.get("BENCH_E2E_FLOOR", "0"))
+        # Staging-arena recycling over the whole run (docs/NATIVE.md):
+        # reuse rate ~1.0 means steady-state windows allocate nothing.
+        ns = eng.native_stats()
+        arena = ns["arena"]
+        arena_cycles = arena["reuses_total"] + arena["allocs_total"]
+        # Host share of e2e wall is the tiered pipeline's headline
+        # denominator: it must ALWAYS print; BENCH_E2E_HOST_SHARE sets
+        # an optional ceiling gate on it.
+        share_cap = os.environ.get("BENCH_E2E_HOST_SHARE")
+        host_share_gate = {"host_share_of_wall": round(host_share, 4)}
+        if share_cap is not None:
+            host_share_gate["host_share_cap"] = float(share_cap)
+            host_share_gate["host_share_pass"] = host_share <= float(share_cap)
         res = {
             "req_per_s": req_per_s,
             "req_per_s_best": round(n_requests / best, 1),
@@ -995,8 +1017,18 @@ def _config_e2e(iters):
                 "requests_per_window": round(
                     fe.get("window_requests", 0) / max(fe.get("windows", 1), 1), 1
                 ),
+                "native_tiered": ns.get("tiered", False),
+                "arena_reuse_rate": round(
+                    arena["reuses_total"] / arena_cycles, 4
+                )
+                if arena_cycles
+                else 0.0,
             },
-            "gate": {"floor_req_per_s": floor, "pass": req_per_s >= floor},
+            "gate": {
+                "floor_req_per_s": floor,
+                "pass": req_per_s >= floor,
+                **host_share_gate,
+            },
             "boundary": "client HTTP round trip per request, localhost,"
             " keep-alive pipelined connections, shared host",
             "corpus": corpus_info,
@@ -1007,6 +1039,10 @@ def _config_e2e(iters):
             res["error"] = f"{non_200} non-verdict responses"
         elif floor > 0 and req_per_s < floor:
             res["error"] = f"throughput floor: {req_per_s} < {floor} req/s"
+        elif share_cap is not None and not host_share_gate["host_share_pass"]:
+            res["error"] = (
+                f"host share of wall {host_share:.3f} > cap {share_cap}"
+            )
         return res
     finally:
         sc.stop()

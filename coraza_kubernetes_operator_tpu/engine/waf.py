@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -380,6 +381,16 @@ class InFlightBatch:
     host_s: float = 0.0
     device_s: float = 0.0
     decode_s: float = 0.0
+    # Host assemble segment alone (blob -> dispatch-ready tensors, before
+    # the dispatch enqueue) — the tiered-pipeline gate in ingest_smoke
+    # compares THIS across native paths; host_s would dilute the ratio
+    # with enqueue/compile-check costs common to both.
+    assemble_s: float = 0.0
+    # Staging-arena lease backing this window's tier tensors (tiered
+    # native path only). collect() releases it after device_get — the
+    # device has consumed the host buffers by then, so the arena may
+    # recycle them into the next window.
+    arena_lease: object = None
 
 
 class WafEngine:
@@ -448,6 +459,11 @@ class WafEngine:
         from ..native import NativeTensorizer
 
         self._native = NativeTensorizer(self.compiled)
+        # Recent per-window host-assemble walls (blob -> dispatch-ready
+        # tensors, both native paths): the ingest smoke's tiered-vs-legacy
+        # p50 gate and the stats native block read this. deque.append is
+        # atomic under the GIL, so concurrent lane dispatch needs no lock.
+        self.blob_assemble_s: deque[float] = deque(maxlen=4096)
         # Kind -> matcher-block bitmask table (kind-partitioned matching):
         # bit i of entry k = block i (segs then banks, build_model order)
         # has a group some rule can reach through kind k. tier_tensors
@@ -767,10 +783,18 @@ class WafEngine:
                 cache_pop=False,
                 host_s=time.perf_counter() - t0,
             )
-        tiers, numvals, masks, cached, mkeys = self._batch_tensors(live)
-        inflight = self._dispatch_tiers(
-            tiers, numvals, len(live), masks=masks, cached=cached, miss_keys=mkeys
-        )
+        tiers, numvals, masks, cached, mkeys, lease = self._batch_tensors(live)
+        t_assemble = time.perf_counter() - t0
+        try:
+            inflight = self._dispatch_tiers(
+                tiers, numvals, len(live), masks=masks, cached=cached, miss_keys=mkeys
+            )
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
+        inflight.arena_lease = lease
+        inflight.assemble_s = t_assemble
         inflight.n_requests = len(requests)
         inflight.rejected = rejected
         inflight.host_s = time.perf_counter() - t0
@@ -828,11 +852,31 @@ class WafEngine:
                         if v.interrupted
                         else Verdict(interrupted=True, status=413, rule_id=None)
                     )
-        tensors = self._native.tensorize_blob(blob, n_req)
-        tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
-        inflight = self._dispatch_tiers(
-            tiers, numvals, n_req, masks=masks, cached=cached, miss_keys=mkeys
-        )
+        lease = None
+        if getattr(self._native, "tiered", False):
+            # Tiered window pipeline: blob -> tier-bucketed tensors in
+            # arena staging buffers, two GIL-released native calls with
+            # only the value-cache probe in Python between them.
+            tiers, numvals, masks, cached, mkeys, lease = (
+                self._native.tier_blob(
+                    blob, n_req, self._kind_block_lut, self.value_cache
+                )
+            )
+        else:
+            tensors = self._native.tensorize_blob(blob, n_req)
+            tiers, numvals, masks, cached, mkeys = self.tier_cached(tensors)
+        t_assemble = time.perf_counter() - t0
+        self._record_assemble(t_assemble)
+        try:
+            inflight = self._dispatch_tiers(
+                tiers, numvals, n_req, masks=masks, cached=cached, miss_keys=mkeys
+            )
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
+        inflight.arena_lease = lease
+        inflight.assemble_s = t_assemble
         inflight.overrides = overrides or None
         inflight.host_s = time.perf_counter() - t0
         return inflight
@@ -843,7 +887,18 @@ class WafEngine:
         its miss rows, and decode the packed verdict array. FIFO
         collection order is the caller's contract (the batcher's
         collector thread drains windows in dispatch order)."""
+        try:
+            return self._collect(inflight)
+        finally:
+            # Arena recycle point (tiered native path): device_get on the
+            # window's outputs has returned, so execution — and therefore
+            # every read of the host staging buffers — is complete. An
+            # abandoned window (collect never called) just leaks one
+            # buffer set; the arena reallocates on the next miss.
+            if inflight.arena_lease is not None:
+                inflight.arena_lease.release()
 
+    def _collect(self, inflight: InFlightBatch) -> list[Verdict]:
         if inflight.out is None:
             return [
                 inflight.rejected[i] for i in range(inflight.n_requests)
@@ -880,6 +935,24 @@ class WafEngine:
             out.append(
                 inflight.rejected[i] if i in inflight.rejected else next(it)
             )
+        return out
+
+    def _record_assemble(self, dt: float) -> None:
+        self.blob_assemble_s.append(dt)
+
+    def native_stats(self) -> dict:
+        """Native window-pipeline counters (stats ``native`` block +
+        metrics gauges): tiered-path availability, window totals and p50,
+        host-assemble p50 across both native paths, and the staging-arena
+        pool counters."""
+        stats_fn = getattr(self._native, "stats", None)
+        out = stats_fn() if stats_fn is not None else {}
+        out["available"] = self._native.available
+        out["tiered"] = getattr(self._native, "tiered", False)
+        recent = sorted(self.blob_assemble_s)
+        out["p50_assemble_ms"] = (
+            recent[len(recent) // 2] * 1e3 if recent else 0.0
+        )
         return out
 
     def tier(self, tensors):
@@ -1333,19 +1406,38 @@ class WafEngine:
         engines whose signatures match share every compiled executable."""
         from .tier_compile import spec_key
 
-        tiers, numvals, masks, cached, _mkeys = self._batch_tensors(requests)
+        tiers, numvals, masks, cached, _mkeys, lease = self._batch_tensors(
+            requests
+        )
         match_specs, post_spec, _pairs = self._tier_specs(
             tiers, numvals, max_phase=max_phase, masks=masks, cached=cached
         )
+        if lease is not None:
+            lease.release()  # signature only reads shapes; no dispatch
         return tuple(spec_key(s) for s in match_specs + [post_spec])
 
     def _batch_tensors(self, requests: list[HttpRequest]):
+        """Tensorize + tier one request batch. Returns ``(tiers, numvals,
+        masks, cached, miss_keys, lease)`` — lease is the staging-arena
+        lease on the tiered native path (the caller releases it once the
+        device step has consumed the buffers) and None elsewhere."""
+        # getattr: tests stub ``_native`` with bare objects that only
+        # carry ``available`` to force the Python fallback.
+        if getattr(self._native, "tiered", False):
+            from ..native import serialize_requests
+
+            return self._native.tier_blob(
+                serialize_requests(requests),
+                len(requests),
+                self._kind_block_lut,
+                self.value_cache,
+            )
         if self._native.available:
             tensors = self._native.tensorize(requests)
         else:
             extractions = [self.extractor.extract(r) for r in requests]
             tensors = self._tensorize(extractions)
-        return self.tier_cached(tensors)
+        return self.tier_cached(tensors) + (None,)
 
     def prewarm(self, requests: list[HttpRequest] | None = None) -> dict:
         """AOT-lower and pre-compile this engine's executable for the
@@ -1379,7 +1471,9 @@ class WafEngine:
 
             batches.append(synthetic_requests(warm_n, attack_ratio=0.1, seed=7))
         for batch in batches:
-            tiers, numvals, masks, cached, _mkeys = self._batch_tensors(batch)
+            tiers, numvals, masks, cached, _mkeys, lease = self._batch_tensors(
+                batch
+            )
             match_specs, post_spec, _pairs = self._tier_specs(
                 tiers, numvals, max_phase=2, masks=masks, cached=cached
             )
@@ -1387,6 +1481,8 @@ class WafEngine:
                 TIER_COMPILER.compile_all(match_specs + [post_spec]) > 0
                 or compiled
             )
+            if lease is not None:
+                lease.release()  # AOT compile only; nothing dispatched
         return {"compiled": compiled, "wall_s": time.perf_counter() - t0}
 
     # -- phase-split serving -------------------------------------------------

@@ -17,11 +17,15 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
+import time
+from collections import deque
 from pathlib import Path
 
 import numpy as np
 
 from ..engine.request import HttpRequest
+from .arena import StagingArena
 
 # Transform opcode order — must match TransformOp in native/src/cko_native.cpp.
 _OPCODES = {
@@ -92,8 +96,11 @@ def load_library():
     lib.cko_ctx_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.cko_ctx_free.argtypes = [ctypes.c_void_p]
     lib.cko_tensorize.restype = ctypes.c_void_p
+    # Blob parameters are c_void_p, not c_char_p: ctypes passes bytes AND
+    # buffer-protocol wrappers (from_buffer over the ingest frontend's
+    # bytearray) to a void* without copying — see _buf_arg.
     lib.cko_tensorize.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int
     ]
     lib.cko_result_rows.argtypes = [ctypes.c_void_p]
     lib.cko_result_maxlen.argtypes = [ctypes.c_void_p]
@@ -117,7 +124,7 @@ def load_library():
     try:
         lib.cko_blob_overlimit.restype = ctypes.c_int
         lib.cko_blob_overlimit.argtypes = [
-            ctypes.c_char_p,
+            ctypes.c_void_p,
             ctypes.c_size_t,
             ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_int32),
@@ -125,8 +132,66 @@ def load_library():
         ]
     except AttributeError:
         pass  # older .so without the scanner; blob_over_limit walks in Python
+    try:
+        # Window-plan ABI (tiered export): blob -> tier-bucketed plan in one
+        # GIL-released call, then one export call scattering every tier into
+        # the staging arena. Older .so -> NativeTensorizer.tiered is False
+        # and the per-window _export path serves.
+        lib.cko_plan_new.restype = ctypes.c_void_p
+        lib.cko_plan_new.argtypes = [
+            ctypes.c_void_p,  # ctx
+            ctypes.c_void_p,  # blob
+            ctypes.c_size_t,  # len
+            ctypes.c_int,     # n_req
+            ctypes.c_void_p,  # tier bounds (int64[])
+            ctypes.c_int,     # n_bounds
+            ctypes.c_int,     # min_tier_rows
+            ctypes.c_void_p,  # kind lut (int64[]) or NULL
+            ctypes.c_int,     # lut_len
+            ctypes.c_int,     # max_parts
+            ctypes.c_int,     # min_part_rows
+            ctypes.c_int,     # min_len
+        ]
+        lib.cko_plan_ntiers.restype = ctypes.c_int
+        lib.cko_plan_ntiers.argtypes = [ctypes.c_void_p]
+        lib.cko_plan_tiers.restype = ctypes.c_int
+        lib.cko_plan_tiers.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.cko_plan_keys.restype = ctypes.c_int
+        lib.cko_plan_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p
+        ]
+        lib.cko_plan_export.restype = ctypes.c_int
+        lib.cko_plan_export.argtypes = [
+            ctypes.c_void_p,  # plan
+            ctypes.c_void_p,  # ptrs (uint64[9 * n_tiers])
+            ctypes.c_void_p,  # dims (int64[4 * n_tiers])
+            ctypes.c_void_p,  # miss_all (int32[]) or NULL
+            ctypes.c_void_p,  # miss_off (int64[]) or NULL
+            ctypes.c_void_p,  # numvals
+            ctypes.c_int,     # B
+            ctypes.c_int,     # NV
+            ctypes.c_int,     # n_req_pad
+        ]
+        lib.cko_plan_free.argtypes = [ctypes.c_void_p]
+        lib._cko_has_plan = True
+    except AttributeError:
+        lib._cko_has_plan = False
     _lib = lib
     return _lib
+
+
+def _buf_arg(blob):
+    """Zero-copy c_void_p argument for a request blob: bytes pass through
+    ctypes directly; any writable buffer-protocol object (the ingest
+    frontend's window bytearray, a numpy view) is wrapped via
+    from_buffer — no copy either way. Read-only non-bytes views are the
+    one (cold) case that degrades to a copy."""
+    if isinstance(blob, bytes):
+        return blob
+    try:
+        return (ctypes.c_ubyte * len(blob)).from_buffer(blob)
+    except (TypeError, BufferError):
+        return memoryview(blob).tobytes()
 
 
 def _pack_str(b: bytes) -> bytes:
@@ -295,8 +360,19 @@ def serialize_requests(requests: list[HttpRequest]) -> bytes:
     return b"".join(parts)
 
 
-# Shape bucketing must stay bit-for-bit identical to the Python path.
-from ..engine.waf import _MIN_LEN, _bucket, _bucket_rows  # noqa: E402
+# Shape bucketing + tier policy knobs must stay bit-for-bit identical to
+# the Python path (engine/waf.py::tier_tensors is the reference).
+from ..engine.waf import (  # noqa: E402
+    _MIN_LEN,
+    _MIN_PART_ROWS,
+    _MIN_TIER_ROWS,
+    _TIER_BOUNDS,
+    _TIER_PARTS,
+    _bucket,
+    _bucket_rows,
+)
+
+_BOUNDS_ARR = np.asarray(_TIER_BOUNDS, dtype=np.int64)
 
 
 class NativeTensorizer:
@@ -306,6 +382,15 @@ class NativeTensorizer:
     def __init__(self, crs):
         self._lib = load_library()
         self._ctx = None
+        # Tiered-export state: the staging arena is per-tensorizer (hence
+        # per-engine — a hot swap gets a fresh arena, old buffers can
+        # never serve the new engine) and the window timings feed
+        # cko_native_window_s / the stats native block.
+        self._arena = StagingArena()
+        self._stats_lock = threading.Lock()
+        self.windows_total = 0
+        self.window_s_total = 0.0
+        self._window_recent: deque[float] = deque(maxlen=512)
         if self._lib is None:
             return
         blob = serialize_config(crs)
@@ -321,6 +406,18 @@ class NativeTensorizer:
     @property
     def available(self) -> bool:
         return self._ctx is not None
+
+    @property
+    def tiered(self) -> bool:
+        """True when the one-call tiered window pipeline serves blob
+        windows. Requires the plan ABI in the loaded .so; CKO_NATIVE_TIERED=0
+        forces the legacy per-window _export + Python tiering path (read
+        per call, so a smoke can A/B the two on one engine)."""
+        return (
+            self._ctx is not None
+            and getattr(self._lib, "_cko_has_plan", False)
+            and os.environ.get("CKO_NATIVE_TIERED", "1") != "0"
+        )
 
     def tensorize_json(self, body: bytes):
         """Bulk-evaluate JSON body → (tensors, n_requests, request_blob).
@@ -356,13 +453,188 @@ class NativeTensorizer:
         ``serialize_requests`` wire format). The async ingest frontend
         packs parsed request bytes straight into this layout, so a full
         ingest window reaches C++ as one contiguous buffer with zero
-        per-request Python object materialization."""
+        per-request Python object materialization. Accepts bytes or any
+        buffer-protocol object — the blob is handed to C++ without a
+        copy (the old ``bytes(blob)`` defensive copy re-paid the whole
+        window's bytes per call)."""
         assert self._ctx is not None
-        blob = bytes(blob)
-        res = self._lib.cko_tensorize(self._ctx, blob, len(blob), n_req)
+        buf = _buf_arg(blob)
+        res = self._lib.cko_tensorize(self._ctx, buf, len(blob), n_req)
         if not res:
             raise RuntimeError("native tensorize failed (malformed batch blob)")
         return self._export(res, n_req)
+
+    def tier_blob(self, blob, n_req: int, kind_lut, cache=None):
+        """The one-call window pipeline: raw request blob -> tier-bucketed,
+        value-dedup'd, dispatch-ready tensors written into staging-arena
+        buffers. Parse, extraction, transforms, tier assignment, kind
+        partitioning, and the dedup all run in ONE GIL-released C++ call
+        (cko_plan_new); Python keeps only the value-cache probe; a second
+        GIL-released call (cko_plan_export) scatters every tier straight
+        into reusable page-aligned buffers, zeroing only pad regions.
+
+        Returns ``(tiers, numvals, masks, cached, miss_keys, lease)`` —
+        the first five bit-identical to ``WafEngine.tier_cached(
+        tensorize_blob(blob, n_req))``, plus the arena lease the caller
+        releases once the window's device step has consumed the host
+        buffers (``WafEngine.collect``)."""
+        assert self.tiered
+        lib = self._lib
+        t0 = time.perf_counter()
+        buf = _buf_arg(blob)
+        if kind_lut is None or _TIER_PARTS <= 1:
+            lut_ptr, lut_len, max_parts = None, 0, 1
+        else:
+            kind_lut = np.ascontiguousarray(kind_lut, dtype=np.int64)
+            lut_ptr = kind_lut.ctypes.data_as(ctypes.c_void_p)
+            lut_len = kind_lut.shape[0]
+            max_parts = _TIER_PARTS
+        plan = lib.cko_plan_new(
+            self._ctx,
+            buf,
+            len(blob),
+            n_req,
+            _BOUNDS_ARR.ctypes.data_as(ctypes.c_void_p),
+            len(_TIER_BOUNDS),
+            _MIN_TIER_ROWS,
+            lut_ptr,
+            lut_len,
+            max_parts,
+            _MIN_PART_ROWS,
+            _MIN_LEN,
+        )
+        if not plan:
+            raise RuntimeError("native tensorize failed (malformed batch blob)")
+        lease = None
+        try:
+            nt = lib.cko_plan_ntiers(plan)
+            meta = np.zeros(nt * 6, dtype=np.int64)
+            lib.cko_plan_tiers(plan, meta.ctypes.data_as(ctypes.c_void_p))
+            meta = meta.reshape(nt, 6)
+            masks = tuple(
+                int(m[5]) if m[4] else None for m in meta.tolist()
+            )
+
+            # Value-cache probe — the ONLY per-window Python between the
+            # two native calls. Keys and their sorted-unique order come
+            # from C++; the probe decides which unique rows the matcher
+            # must run (miss) vs which replay packed hit rows (found).
+            miss_lists: list[list[int]] = [None] * nt  # type: ignore[list-item]
+            if cache is None:
+                cached = None
+                miss_keys = None
+            else:
+                cached_l = []
+                miss_keys = []
+                for ti in range(nt):
+                    n_uniq = int(meta[ti, 2])
+                    key_len = int(meta[ti, 3])
+                    kb = np.empty(n_uniq * key_len, dtype=np.uint8)
+                    lib.cko_plan_keys(
+                        plan, ti, kb.ctypes.data_as(ctypes.c_void_p)
+                    )
+                    prefix = int(
+                        -1 if masks[ti] is None else masks[ti]
+                    ).to_bytes(8, "little", signed=True)
+                    kbytes = kb.tobytes()
+                    ukeys = [
+                        prefix + kbytes[i * key_len : (i + 1) * key_len]
+                        for i in range(n_uniq)
+                    ]
+                    found, miss = cache.lookup(ukeys)
+                    miss_lists[ti] = miss
+                    miss_keys.append([ukeys[j] for j in miss])
+                    cpk = np.zeros(
+                        (_bucket_rows(max(1, len(found))), cache.packed_len),
+                        dtype=np.uint8,
+                    )
+                    for r, (_j, row) in enumerate(sorted(found.items())):
+                        cpk[r] = row
+                    cached_l.append(cpk)
+                cached = tuple(cached_l)
+
+            h = max(1, self._n_host)
+            b = _bucket(max(1, n_req))
+            dims = np.zeros(nt * 4, dtype=np.int64)
+            shapes = []
+            for ti in range(nt):
+                length, n_pairs, n_uniq = (
+                    int(meta[ti, 0]), int(meta[ti, 1]), int(meta[ti, 2])
+                )
+                n_miss = (
+                    n_uniq if cache is None else len(miss_lists[ti])
+                )
+                u = _bucket_rows(max(1, n_miss))
+                p = _bucket_rows(max(1, n_pairs))
+                # u_pad (found-row uid base) == the bucketed miss count.
+                dims[ti * 4 : ti * 4 + 4] = (u, p, u, n_miss)
+                shapes.append((u, length, p))
+
+            lease = self._arena.checkout((tuple(shapes), h, b, self._nv))
+            ptrs = np.zeros(nt * 9, dtype=np.uint64)
+            for ti, bufs in enumerate(lease.tiers):
+                for k in range(9):
+                    ptrs[ti * 9 + k] = bufs[k].ctypes.data
+            if cache is None:
+                miss_ptr = None
+                off_ptr = None
+            else:
+                flat = [j for m in miss_lists for j in m]
+                miss_all = np.zeros(max(1, len(flat)), dtype=np.int32)
+                miss_all[: len(flat)] = flat
+                offs = np.zeros(nt, dtype=np.int64)
+                o = 0
+                for ti in range(nt):
+                    offs[ti] = o
+                    o += len(miss_lists[ti])
+                miss_ptr = miss_all.ctypes.data_as(ctypes.c_void_p)
+                off_ptr = offs.ctypes.data_as(ctypes.c_void_p)
+            rc = lib.cko_plan_export(
+                plan,
+                ptrs.ctypes.data_as(ctypes.c_void_p),
+                dims.ctypes.data_as(ctypes.c_void_p),
+                miss_ptr,
+                off_ptr,
+                lease.numvals.ctypes.data_as(ctypes.c_void_p),
+                b,
+                self._nv,
+                b,
+            )
+            if rc != 0:
+                raise RuntimeError(f"native tiered export failed rc={rc}")
+        except BaseException:
+            if lease is not None:
+                lease.release()
+            raise
+        finally:
+            lib.cko_plan_free(plan)
+        tiers = tuple(lease.tiers[: nt])
+        numvals = lease.numvals
+        dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self.windows_total += 1
+            self.window_s_total += dt
+            self._window_recent.append(dt)
+        return tiers, numvals, masks, cached, miss_keys, lease
+
+    def stats(self) -> dict:
+        """Native-pipeline counters for /waf/v1/stats and the metrics
+        gauges: window totals/latency plus the staging-arena pool."""
+        with self._stats_lock:
+            recent = sorted(self._window_recent)
+            out = {
+                "windows_total": self.windows_total,
+                "window_s_total": self.window_s_total,
+                "p50_window_ms": (
+                    recent[len(recent) // 2] * 1e3 if recent else 0.0
+                ),
+            }
+        out["arena"] = (
+            self._arena.stats()
+            if self._ctx is not None
+            else {"buffers": 0, "reuses_total": 0, "allocs_total": 0}
+        )
+        return out
 
     def _export(self, res, n_requests: int):
         try:
@@ -414,13 +686,18 @@ def blob_over_limit(blob: bytes, limit: int) -> list[int]:
     path. Uses the C scanner when loaded; pure-Python walk otherwise."""
     lib = load_library()
     if lib is not None and getattr(lib, "cko_blob_overlimit", None) is not None:
+        # _buf_arg, not the raw blob: the ingest frontend hands its
+        # window bytearray through here zero-copy, and c_void_p only
+        # accepts bytes (a raw bytearray would ArgumentError and kick
+        # the whole window to the host fallback).
+        buf = _buf_arg(blob)
         cap = 4096
         out = (ctypes.c_int32 * cap)()
-        n = lib.cko_blob_overlimit(blob, len(blob), limit, out, cap)
+        n = lib.cko_blob_overlimit(buf, len(blob), limit, out, cap)
         if n <= cap:
             return list(out[:n])
         out = (ctypes.c_int32 * n)()
-        n = lib.cko_blob_overlimit(blob, len(blob), limit, out, n)
+        n = lib.cko_blob_overlimit(buf, len(blob), limit, out, n)
         return list(out[:n])
     res: list[int] = []
     pos = 0

@@ -1,0 +1,130 @@
+"""Staging arena: page-aligned, reusable host buffers for tiered export.
+
+Every blob window used to allocate nine fresh numpy arrays (`np.zeros`
+churn in ``native/__init__.py::_export``) and then re-slice them into
+tier buffers in Python. The arena replaces that with a pool of
+buffer SETS keyed by the window's quantized shape signature — the
+``_bucket``/``_bucket_rows`` lattice keeps the key space tiny, so
+steady-state serving recycles the same few sets forever. C++
+(``cko_plan_export``) writes real rows and zeroes ONLY the pad regions
+it does not write, so a dirty reused buffer is indistinguishable from a
+fresh ``np.zeros`` one.
+
+Recycling discipline: a set is checked out under an ``ArenaLease`` and
+must not return to the pool until the window's device step has consumed
+the host arrays — ``WafEngine.collect`` releases the lease after
+``device_get`` (execution done implies inputs consumed; the CPU backend
+may alias suitably-aligned numpy buffers zero-copy, which is exactly why
+these buffers are page-aligned AND why early recycling would corrupt an
+in-flight window). A lease that is never released (abandoned window)
+just leaks one buffer set — the pool reallocates on the next miss.
+
+The arena lives on the ``NativeTensorizer`` — one per engine — so an
+engine hot-swap gets a fresh arena and buffers from the old engine can
+never serve windows of the new one.
+
+``CKO_STAGING_ARENA_MAX`` bounds retained sets across all signatures
+(default 64; 0 keeps the arena transient: every checkout allocates and
+every release drops).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_PAGE = 4096
+
+
+def _aligned(shape, dtype):
+    """A page-aligned numpy array (XLA's CPU client can then borrow the
+    buffer zero-copy instead of re-staging it)."""
+    dt = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dt.itemsize
+    raw = np.empty(nbytes + _PAGE, dtype=np.uint8)
+    off = (-raw.ctypes.data) % _PAGE
+    return raw[off : off + nbytes].view(dt).reshape(shape)
+
+
+class ArenaLease:
+    """One checked-out buffer set: ``tiers`` is a list of 9-tuples
+    (data, lengths, k1, k2, k3, req_id, vdata, vlengths, uid) and
+    ``numvals`` the per-request numeric matrix. ``release()`` returns
+    the set to the pool (idempotent — double release is a no-op, never
+    a double-insert)."""
+
+    __slots__ = ("tiers", "numvals", "_arena", "_key", "_set", "_released")
+
+    def __init__(self, arena, key, bufset):
+        self._arena = arena
+        self._key = key
+        self._set = bufset
+        self._released = False
+        self.tiers = bufset[0]
+        self.numvals = bufset[1]
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._arena._put_back(self._key, self._set)
+
+
+class StagingArena:
+    """Thread-safe pool of tier-shaped staging buffer sets."""
+
+    def __init__(self, max_sets: int | None = None):
+        if max_sets is None:
+            max_sets = int(os.environ.get("CKO_STAGING_ARENA_MAX", "64"))
+        self.max_sets = max_sets
+        self._pool: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+        self._retained = 0
+        self.reuses_total = 0
+        self.allocs_total = 0
+
+    def checkout(self, signature: tuple) -> ArenaLease:
+        """signature = (((U, L, P), ...per tier), H, B, NV)."""
+        with self._lock:
+            sets = self._pool.get(signature)
+            if sets:
+                bufset = sets.pop()
+                self._retained -= 1
+                self.reuses_total += 1
+                return ArenaLease(self, signature, bufset)
+            self.allocs_total += 1
+        tier_shapes, h, b, nv = signature
+        tiers = []
+        for u, length, p in tier_shapes:
+            tiers.append(
+                (
+                    _aligned((u, length), np.uint8),    # data
+                    _aligned((u,), np.int32),           # lengths
+                    _aligned((p,), np.int32),           # k1
+                    _aligned((p,), np.int32),           # k2
+                    _aligned((p,), np.int32),           # k3
+                    _aligned((p,), np.int32),           # req_id
+                    _aligned((h, u, length), np.uint8),  # vdata
+                    _aligned((h, u), np.int32),         # vlengths
+                    _aligned((p,), np.int32),           # uid
+                )
+            )
+        numvals = _aligned((b, nv), np.int32)
+        return ArenaLease(self, signature, (tuple(tiers), numvals))
+
+    def _put_back(self, signature: tuple, bufset) -> None:
+        with self._lock:
+            if self._retained >= self.max_sets:
+                return  # transient: drop, the GC reclaims it
+            self._pool.setdefault(signature, []).append(bufset)
+            self._retained += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "buffers": self._retained,
+                "reuses_total": self.reuses_total,
+                "allocs_total": self.allocs_total,
+            }

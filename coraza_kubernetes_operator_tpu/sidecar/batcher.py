@@ -361,7 +361,9 @@ class _BlobWindow:
     per-request Python objects. The future resolves to the window's
     ``list[Verdict]`` (or the group error)."""
 
-    blob: bytes
+    # bytes OR the ingest frontend's handed-off bytearray — either way it
+    # reaches the native tensorizer zero-copy via the buffer protocol.
+    blob: bytes | bytearray
     n_req: int
     fut: Future
     # Flight-recorder contexts (observability/tracing.py), aligned with
@@ -797,7 +799,8 @@ class MicroBatcher:
         return fut
 
     def submit_window(
-        self, blob: bytes, n_req: int, spans=None, lane: str = LANE_BULK
+        self, blob: bytes | bytearray, n_req: int, spans=None,
+        lane: str = LANE_BULK
     ) -> Future:
         """Enqueue a pre-assembled ingest window (request blob in the
         ``native.serialize_requests`` format). Dispatched as its own

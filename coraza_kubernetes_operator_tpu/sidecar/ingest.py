@@ -1051,7 +1051,11 @@ class AsyncIngestFrontend:
         futs = self._win_futs[lane]
         if not futs:
             return
-        blob = bytes(self._win_buf[lane])
+        # Ownership handoff, not a copy: the assembled bytearray itself
+        # rides to the batcher (a fresh one replaces it for the next
+        # window) and reaches C++ through the buffer protocol — the old
+        # bytes() here re-paid every window's bytes once per flush.
+        blob = self._win_buf[lane]
         spans = self._win_traces[lane]
         self._win_futs[lane] = []
         self._win_buf[lane] = bytearray()
@@ -1071,7 +1075,8 @@ class AsyncIngestFrontend:
                     f.set_result(reply)
 
     def _dispatch_window(
-        self, blob: bytes, futs: list, spans=None, lane: str = LANE_BULK
+        self, blob: bytes | bytearray, futs: list, spans=None,
+        lane: str = LANE_BULK
     ) -> None:
         """Route one assembled window. Runs on the loop thread — every
         step here is a cheap probe; blocking work goes to the batcher or

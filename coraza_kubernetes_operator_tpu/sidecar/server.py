@@ -823,6 +823,23 @@ class TpuEngineSidecar:
             "Device stage per window group (readback block + decode)",
         )
         self.batcher.stats.on_stage = self._on_stage
+        # -- native window pipeline + staging arena (docs/NATIVE.md) --------
+        self.metrics.gauge(
+            "cko_native_window_s",
+            "Cumulative seconds in the native blob->tensors window pipeline",
+        ).set_function(lambda: self._native_stat("window_s_total"))
+        self.metrics.gauge(
+            "cko_staging_arena_buffers",
+            "Staging-arena buffer sets currently pooled for reuse",
+        ).set_function(lambda: float(self._arena_stat("buffers")))
+        self.metrics.gauge(
+            "cko_staging_arena_reuses_total",
+            "Window exports served from a recycled staging buffer set",
+        ).set_function(lambda: float(self._arena_stat("reuses_total")))
+        self.metrics.gauge(
+            "cko_staging_arena_allocs_total",
+            "Staging buffer sets allocated (arena misses)",
+        ).set_function(lambda: float(self._arena_stat("allocs_total")))
         # -- priority lanes + fair admission (docs/SERVING.md) --------------
         m_lane_pending = self.metrics.gauge(
             "cko_lane_pending",
@@ -2509,6 +2526,30 @@ class TpuEngineSidecar:
             return 0
         return int(getattr(engine.compiled.report, field, 0))
 
+    def _native_summary(self) -> dict:
+        """The default tenant's native window-pipeline summary (tiered
+        availability, window counts/latency, staging-arena counters;
+        docs/NATIVE.md), or a disabled stub while no engine is resident
+        or the engine is a test stub without the native bridge."""
+        engine = self.tenants.engine_for(None)
+        if engine is None or not hasattr(engine, "native_stats"):
+            return {
+                "available": False,
+                "tiered": False,
+                "windows_total": 0,
+                "window_s_total": 0.0,
+                "p50_window_ms": 0.0,
+                "p50_assemble_ms": 0.0,
+                "arena": {"buffers": 0, "reuses_total": 0, "allocs_total": 0},
+            }
+        return engine.native_stats()
+
+    def _native_stat(self, key: str) -> float:
+        return float(self._native_summary()[key])
+
+    def _arena_stat(self, key: str) -> int:
+        return int(self._native_summary()["arena"][key])
+
     def _automata_summary(self) -> dict:
         """The default tenant's two-level automata summary (tier counts,
         bank counts, prefilter confirm counters; docs/AUTOMATA.md), or a
@@ -2601,6 +2642,7 @@ class TpuEngineSidecar:
             "resident_engines": self.tenants.resident_engines(),
             "engine_dedup_hits": self.tenants.engine_dedup_hits,
             "automata": self._automata_summary(),
+            "native": self._native_summary(),
             "analysis": {
                 "cko_analysis_findings_total": self.tenants.analysis_counts(),
                 "rejected_reloads": self.tenants.total_analyze_rejected,

@@ -13,7 +13,13 @@ pipelined multi-connection client, and asserts:
    thread + HttpRequest materialization per request), and
 2. the two frontends' verdicts are BIT-IDENTICAL per request
    (status + x-waf-action + x-waf-rule-id): the frontend is a transport,
-   it must never alter a verdict.
+   it must never alter a verdict, and
+3. when the tiered native window pipeline is built (docs/NATIVE.md), a
+   third async pass with CKO_NATIVE_TIERED=0 gates the blob-window
+   host-assemble p50: tiered must be >= 2x faster than the legacy
+   export + Python tiering on multicore (loud no-regression gate,
+   <= 1.15x legacy, on one core), with bit-identical verdicts and
+   arena_reuses_total > 0 after warmup.
 
 Usage: ingest_smoke.py [--ratio 2.0] [--requests 2400] [--conns 8]
 [--depth 32] (env overrides: INGEST_SMOKE_RATIO / _REQUESTS / _CONNS /
@@ -133,6 +139,14 @@ def main() -> int:
         ratio = 0.9
 
     os.environ.setdefault("CKO_VALUE_CACHE_MB", "0")
+    # Verdict cache OFF (honesty, same reason as the bench e2e config):
+    # the timed passes replay the warm pass's stream, so with the
+    # fingerprint cache hooked nearly every window is served at
+    # assembly — the blob windows would route through the split
+    # (materializing) dispatch and the tiered-vs-legacy assemble gate
+    # would never see a prepare_blob. Cache speedup has its own smoke
+    # (hack/verdict_cache_smoke.py).
+    os.environ.setdefault("CKO_VERDICT_CACHE_MAX", "0")
     sys.path.insert(0, str(REPO))
     import jax
 
@@ -159,9 +173,25 @@ def main() -> int:
     ]
     warm = payloads[: min(256, len(payloads))]
 
+    # Three passes over the identical stream: the legacy threaded
+    # frontend, the async frontend with the tiered native window
+    # pipeline forced OFF (CKO_NATIVE_TIERED=0 -> per-window _export +
+    # Python tiering), and the async frontend on the default tiered
+    # path (docs/NATIVE.md). threaded-vs-async keeps the original 2x
+    # end-to-end gate; async-legacy-vs-async gates the blob-window
+    # host-assemble p50 and proves the arena actually recycles.
+    native_tiered = eng._native.tiered
+    passes = [("threaded", "threaded", None)]
+    if native_tiered:
+        passes.append(("async-legacy", "async", "0"))
+    passes.append(("async", "async", None))
+
     results = {}
     frontend_stats = {}
-    for frontend in ("threaded", "async"):
+    assemble_p50 = {}
+    for name, frontend, tiered_env in passes:
+        if tiered_env is not None:
+            os.environ["CKO_NATIVE_TIERED"] = tiered_env
         sc = TpuEngineSidecar(
             SidecarConfig(
                 host="127.0.0.1",
@@ -178,15 +208,24 @@ def main() -> int:
             while time.monotonic() < deadline and sc.serving_mode() != "promoted":
                 time.sleep(0.05)
             _drive(sc.port, warm, conns, depth)  # untimed warm
+            eng.blob_assemble_s.clear()  # steady-state windows only
             verdicts, wall = _drive(sc.port, payloads, conns, depth)
-            results[frontend] = (verdicts, wall)
-            frontend_stats[frontend] = sc.stats().get("frontend", {})
+            results[name] = (verdicts, wall)
+            frontend_stats[name] = sc.stats().get("frontend", {})
+            samples = sorted(eng.blob_assemble_s)
+            assemble_p50[name] = (
+                samples[len(samples) // 2] if samples else 0.0
+            )
         finally:
             sc.stop()
+            if tiered_env is not None:
+                del os.environ["CKO_NATIVE_TIERED"]
 
     t_verdicts, t_wall = results["threaded"]
     a_verdicts, a_wall = results["async"]
     identical = a_verdicts == t_verdicts
+    if native_tiered:
+        identical = identical and a_verdicts == results["async-legacy"][0]
     blocked = sum(1 for v in a_verdicts if v[1] == "deny")
     t_rps = n_requests / max(t_wall, 1e-9)
     a_rps = n_requests / max(a_wall, 1e-9)
@@ -214,6 +253,35 @@ def main() -> int:
         "single_core_degraded_gate": single_core and not ratio_explicit,
     }
     ok = speedup >= ratio and identical and blocked > 0
+
+    # Tiered-native host-assemble gate (docs/NATIVE.md): the one-call
+    # blob -> arena-tensors pipeline must cut the per-window host
+    # assemble p50 >= 2x vs the legacy export + Python tiering on
+    # multicore; on one core it degrades (loudly) to no-regression
+    # (tiered no worse than 1.15x legacy). The arena must have actually
+    # recycled buffers during the tiered pass.
+    if native_tiered:
+        legacy_p50 = assemble_p50.get("async-legacy", 0.0)
+        tiered_p50 = assemble_p50.get("async", 0.0)
+        native_speedup = legacy_p50 / max(tiered_p50, 1e-9)
+        native_required = 1.0 / 1.15 if single_core else 2.0
+        arena = eng.native_stats()["arena"]
+        native_ok = (
+            native_speedup >= native_required
+            and arena["reuses_total"] > 0
+        )
+        verdict["native"] = {
+            "assemble_p50_ms_legacy": round(legacy_p50 * 1e3, 4),
+            "assemble_p50_ms_tiered": round(tiered_p50 * 1e3, 4),
+            "speedup": round(native_speedup, 3),
+            "required": round(native_required, 3),
+            "single_core_degraded_gate": single_core,
+            "arena": arena,
+        }
+        ok = ok and native_ok
+    else:
+        verdict["native"] = "SKIP: tiered pipeline unavailable (make native)"
+
     verdict["smoke"] = "PASS" if ok else "FAIL"
     print(json.dumps(verdict))
     return 0 if ok else 1

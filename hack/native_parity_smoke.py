@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Native-parity fuzz gate (`make native.parity`, CI job native-parity).
+
+The tiered native window pipeline (cko_plan_new/cko_plan_export,
+docs/NATIVE.md) replaces per-window Python tiering + numpy exports with
+two GIL-released C++ calls scattering into staging-arena buffers — and
+its contract is BIT-IDENTICAL tensors and verdicts against the pure
+Python fallback (`blob_requests` -> extract -> `_tensorize` ->
+`tier_tensors`). This smoke replays two corpora through both paths and
+fails on the first divergence:
+
+  1. The ingest-fuzz corpus (hack/ingest_fuzz.py, all mutation
+     families): each family's raw HTTP byte stream is embedded into
+     request fields (body, query, header, cookie) deterministically, so
+     the tensorizer sees the fuzz corpus's byte soup — NUL bytes, bad
+     encodings, chunked debris, oversized lines — in every collection.
+  2. The bundled go-ftw corpus (ftw/tests + crs-lite rules): real CRS
+     attack/control stages against the full crs-lite ruleset.
+
+Checked per window, against the SAME value-cache state:
+  - tier tensors: every per-tier array (data, lengths, k1..k3, req_id,
+    vdata, vlengths, uid), numvals, masks, cached rows and miss keys
+    from `tier_blob` vs the Python reference pipeline;
+  - verdicts: tiered `prepare_blob` vs legacy native (CKO_NATIVE_TIERED=0)
+    vs the Python `blob_requests` -> `prepare` fallback.
+
+Exit 0 with a summary line on success; exit 1 on any divergence. Skips
+LOUDLY (exit 0) when the native library is not built.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+WINDOW = 64
+FUZZ_SEED = int(os.environ.get("CKO_PARITY_SEED", "0"))
+FUZZ_ITERS = int(os.environ.get("CKO_PARITY_ITERS", "400"))
+FTW_STAGES = int(os.environ.get("CKO_PARITY_FTW_STAGES", "256"))
+
+
+def _verdict_key(v):
+    return (
+        v.interrupted,
+        v.status,
+        v.rule_id,
+        tuple(v.matched_ids),
+        tuple(sorted(v.scores.items())),
+    )
+
+
+def _diff_arrays(a, b, label, failures):
+    if a.shape != b.shape or a.dtype != b.dtype:
+        failures.append(f"{label}: shape/dtype {a.shape}/{a.dtype} vs {b.shape}/{b.dtype}")
+        return
+    if not np.array_equal(a, b):
+        bad = np.argwhere(np.asarray(a) != np.asarray(b))[:3].tolist()
+        failures.append(f"{label}: value divergence at {bad}")
+
+
+def check_window(engine, reqs, failures, tag):
+    """Tensor + verdict parity for one window of requests."""
+    from coraza_kubernetes_operator_tpu.engine.waf import tier_tensors
+    from coraza_kubernetes_operator_tpu.native import (
+        blob_requests,
+        serialize_requests,
+    )
+
+    blob = serialize_requests(reqs)
+    n = len(reqs)
+    cache = engine.value_cache
+
+    # -- tensor parity (same cache state for both probes; neither inserts)
+    if engine._native.tiered:
+        py_reqs = blob_requests(blob, n)
+        extractions = [engine.extractor.extract(r) for r in py_reqs]
+        tensors = engine._tensorize(extractions)
+        if cache is None:
+            p_tiers, p_numvals, p_masks = tier_tensors(
+                tensors, engine._kind_block_lut
+            )
+            p_cached = p_miss = None
+        else:
+            p_tiers, p_numvals, p_masks, p_cached, p_miss = tier_tensors(
+                tensors, engine._kind_block_lut, cache=cache
+            )
+        t_tiers, t_numvals, t_masks, t_cached, t_miss, lease = (
+            engine._native.tier_blob(blob, n, engine._kind_block_lut, cache)
+        )
+        try:
+            if t_masks != p_masks:
+                failures.append(f"{tag}: masks {t_masks} vs {p_masks}")
+            elif len(t_tiers) != len(p_tiers):
+                failures.append(
+                    f"{tag}: tier count {len(t_tiers)} vs {len(p_tiers)}"
+                )
+            else:
+                names = (
+                    "data", "lengths", "k1", "k2", "k3",
+                    "req_id", "vdata", "vlengths", "uid",
+                )
+                for ti, (tt, pt) in enumerate(zip(t_tiers, p_tiers)):
+                    for name, x, y in zip(names, tt, pt):
+                        _diff_arrays(x, y, f"{tag} tier{ti}.{name}", failures)
+                _diff_arrays(t_numvals, p_numvals, f"{tag} numvals", failures)
+                if cache is not None:
+                    for ti, (tc, pc) in enumerate(zip(t_cached, p_cached)):
+                        _diff_arrays(tc, pc, f"{tag} cached{ti}", failures)
+                    if t_miss != p_miss:
+                        failures.append(f"{tag}: miss_keys diverge")
+        finally:
+            lease.release()
+
+    # -- verdict parity: tiered vs legacy native vs Python fallback
+    v_tiered = [
+        _verdict_key(v) for v in engine.collect(engine.prepare_blob(blob, n))
+    ]
+    os.environ["CKO_NATIVE_TIERED"] = "0"
+    try:
+        v_legacy = [
+            _verdict_key(v)
+            for v in engine.collect(engine.prepare_blob(blob, n))
+        ]
+    finally:
+        del os.environ["CKO_NATIVE_TIERED"]
+    v_python = [
+        _verdict_key(v)
+        for v in engine.collect(engine.prepare(blob_requests(blob, n)))
+    ]
+    if not (v_tiered == v_legacy == v_python):
+        for i, (a, b, c) in enumerate(zip(v_tiered, v_legacy, v_python)):
+            if not (a == b == c):
+                failures.append(
+                    f"{tag}: verdict divergence req {i}: "
+                    f"tiered={a} legacy={b} python={c}"
+                )
+                break
+
+
+def fuzz_requests():
+    """Embed every fuzz family's raw byte streams into request fields."""
+    from coraza_kubernetes_operator_tpu.engine import HttpRequest
+    from hack.ingest_fuzz import build_corpus
+
+    corpus = build_corpus(FUZZ_SEED, FUZZ_ITERS)
+    reqs = []
+    for i, (family, payload, _compare, _reset) in enumerate(corpus):
+        chunk = bytes(payload[:2048])
+        text = chunk.decode("latin-1")
+        mode = i % 5
+        headers = [("Host", "parity.local"), ("User-Agent", f"parity/{family}")]
+        body, uri, method = b"", f"/{family}", "GET"
+        if mode == 0:  # raw body
+            method, body = "POST", chunk
+            headers.append(("Content-Type", "text/plain"))
+        elif mode == 1:  # form body
+            method, body = "POST", b"a=" + chunk + b"&b=evil"
+            headers.append(
+                ("Content-Type", "application/x-www-form-urlencoded")
+            )
+        elif mode == 2:  # query string
+            uri = f"/{family}?q=" + text[:512]
+        elif mode == 3:  # header value (CR/LF would be re-framed by a
+            # real frontend; strip to keep the field single-line)
+            headers.append(
+                ("X-Fuzz", text[:256].replace("\r", " ").replace("\n", " "))
+            )
+        else:  # cookie
+            headers.append(
+                ("Cookie",
+                 "sid=" + text[:128].replace("\r", "").replace("\n", "")
+                 + "; session=admin")
+            )
+        reqs.append(
+            HttpRequest(method=method, uri=uri, headers=headers, body=body)
+        )
+    return reqs
+
+
+def ftw_requests():
+    from coraza_kubernetes_operator_tpu.ftw.loader import load_tests
+    from coraza_kubernetes_operator_tpu.ftw.runner import _stage_request
+
+    root = Path(__file__).resolve().parent.parent / "ftw" / "tests"
+    reqs = []
+    for test in load_tests(root):
+        for stage in test.stages:
+            if stage.response_status is None:
+                reqs.append(_stage_request(stage))
+    return reqs[:FTW_STAGES]
+
+
+def run_corpus(engine, reqs, name):
+    failures: list[str] = []
+    windows = 0
+    for off in range(0, len(reqs), WINDOW):
+        win = reqs[off : off + WINDOW]
+        check_window(engine, win, failures, f"{name}/w{windows}")
+        windows += 1
+        if failures:
+            break
+    # Repeat pass: the value cache is now warm, so found/miss remap and
+    # cached-row replay get exercised on every tier.
+    if not failures:
+        for off in range(0, min(len(reqs), 4 * WINDOW), WINDOW):
+            win = reqs[off : off + WINDOW]
+            check_window(engine, win, failures, f"{name}/warm-w{off // WINDOW}")
+            if failures:
+                break
+            windows += 1
+    return windows, failures
+
+
+def main() -> int:
+    from coraza_kubernetes_operator_tpu.engine import WafEngine
+    from coraza_kubernetes_operator_tpu.ftw.corpus import load_ruleset_text
+    from coraza_kubernetes_operator_tpu.native import load_library
+
+    if load_library() is None:
+        print("native-parity SKIP: libcko_native.so not built (make native)")
+        return 0
+
+    t0 = time.time()
+    from coraza_kubernetes_operator_tpu.corpus import sample_rules
+
+    results = {}
+    all_failures: list[str] = []
+
+    fuzz_engine = WafEngine(sample_rules())
+    if not fuzz_engine._native.tiered:
+        print("native-parity SKIP: plan ABI unavailable (rebuild native)")
+        return 0
+    w, fails = run_corpus(fuzz_engine, fuzz_requests(), "fuzz")
+    results["fuzz"] = {"windows": w, "failures": len(fails)}
+    all_failures += fails
+
+    if not all_failures:
+        ftw_engine = WafEngine(load_ruleset_text())
+        w, fails = run_corpus(ftw_engine, ftw_requests(), "ftw")
+        results["ftw"] = {
+            "windows": w,
+            "failures": len(fails),
+            "tiered": ftw_engine._native.tiered,
+        }
+        all_failures += fails
+        arena = ftw_engine.native_stats()["arena"]
+        results["arena"] = arena
+
+    verdict = {
+        "corpora": results,
+        "divergences": len(all_failures),
+        "wall_s": round(time.time() - t0, 1),
+        "smoke": "PASS" if not all_failures else "FAIL",
+    }
+    print("native-parity " + json.dumps(verdict))
+    for f in all_failures[:10]:
+        print("  DIVERGENCE:", f)
+    return 1 if all_failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

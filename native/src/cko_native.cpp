@@ -20,6 +20,7 @@
 #include <charconv>
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <memory>
 #include <string>
 #include <unordered_map>
@@ -840,8 +841,19 @@ struct JsonParser {
     double v = strtod(tok.c_str(), nullptr);
     // Python repr(float): shortest round-trip, with '.0' for integral
     char buf[64];
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     auto res = std::to_chars(buf, buf + sizeof buf, v);
     bytes r(buf, res.ptr - buf);
+#else
+    // libstdc++ < 11 ships integer-only to_chars: probe precisions for
+    // the shortest %g rendering that round-trips (same switch-to-
+    // exponent thresholds as Python repr).
+    for (int prec = 1; prec <= 17; prec++) {
+      snprintf(buf, sizeof buf, "%.*g", prec, v);
+      if (strtod(buf, nullptr) == v) break;
+    }
+    bytes r(buf);
+#endif
     if (r.find('.') == bytes::npos && r.find('e') == bytes::npos &&
         r.find("inf") == bytes::npos && r.find("nan") == bytes::npos)
       r += ".0";
@@ -2389,5 +2401,387 @@ int cko_result_export(void* h, uint8_t* data, int32_t* lengths, int32_t* k1,
 }
 
 void cko_result_free(void* h) { delete (Result*)h; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Window plan: raw request blob -> tier-bucketed, value-dedup'd,
+// dispatch-ready layout in ONE GIL-released call (cko_plan_new), then one
+// export call (cko_plan_export) scattering rows straight into caller-owned
+// staging buffers. Bit-for-bit parity with engine/waf.py::tier_tensors is
+// the contract: tier assignment (length bounds + forward/backward merges),
+// kind partitioning (mask grouping, stable size sort, small-part merging),
+// and the value dedup's sorted-unique key order (np.unique on a void view ==
+// unsigned-byte memcmp with first-occurrence representatives) are all
+// replicated here and held equal by tests/test_native_tiered.py and
+// hack/native_parity_smoke.py. Python keeps only the value-cache probe
+// between the two calls.
+
+namespace {
+
+struct PlanTier {
+  int length = 0;  // bucketed buffer width (matcher executable width)
+  bool has_mask = false;
+  long long mask = 0;                // kind-partition block mask
+  std::vector<int32_t> sel;          // pair rows: indexes into plan rows
+  std::vector<int32_t> first;        // sorted-unique key -> first sel-position
+  std::vector<int32_t> inverse;      // pair row -> sorted-unique position
+  std::vector<uint8_t> keys;         // sorted-unique key bytes [n_uniq*key_len]
+  int key_len = 0;                   // (length + 4) * (1 + H)
+};
+
+struct Plan {
+  Result* res = nullptr;  // owned
+  int n_req_b = 0;        // bucketed request count (pad req_id value)
+  int h = 1;              // variant planes = max(1, host pipelines)
+  bool empty = false;     // zero extracted rows: one synthetic padding row
+  Row synth;
+  std::vector<PlanTier> tiers;
+  const Row& row(int32_t i) const { return empty ? synth : res->rows[i]; }
+  ~Plan() { delete res; }
+};
+
+static long long plan_bucket(long long n) {
+  long long s = 1;
+  while (s < n) s *= 2;
+  return s;
+}
+
+// One tier's dedup: build per-pair-row keys (value + int32 length + per-plane
+// variant + int32 length, each value zero-padded to the tier width — exactly
+// the byte image tier_tensors' np.concatenate produces), sort-unique them
+// with memcmp order and first-occurrence-by-position representatives.
+static void plan_emit(Plan* plan, const std::vector<int32_t>& selv, int length,
+                      bool has_mask, long long mask) {
+  PlanTier t;
+  t.length = length;
+  t.has_mask = has_mask;
+  t.mask = mask;
+  t.sel = selv;
+  const int h = plan->h;
+  const int np_ = (int)selv.size();
+  const int kl = (length + 4) * (1 + h);
+  t.key_len = kl;
+  std::vector<uint8_t> keys((size_t)np_ * kl, 0);
+  for (int r = 0; r < np_; r++) {
+    uint8_t* k = keys.data() + (size_t)r * kl;
+    const Row& rw = plan->row(selv[r]);
+    memcpy(k, rw.value.data(), rw.value.size());
+    size_t off = (size_t)length;
+    int32_t lg = (int32_t)rw.value.size();
+    memcpy(k + off, &lg, 4);
+    off += 4;
+    for (int hi = 0; hi < h; hi++) {
+      const bytes* v =
+          hi < (int)rw.variants.size() ? &rw.variants[hi] : nullptr;
+      if (v && !v->empty()) memcpy(k + off, v->data(), v->size());
+      off += (size_t)length;
+      int32_t vl = v ? (int32_t)v->size() : 0;
+      memcpy(k + off, &vl, 4);
+      off += 4;
+    }
+  }
+  std::vector<int32_t> order(np_);
+  for (int r = 0; r < np_; r++) order[r] = r;
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    int c = memcmp(keys.data() + (size_t)a * kl, keys.data() + (size_t)b * kl,
+                   (size_t)kl);
+    if (c) return c < 0;
+    return a < b;  // ties ascending: first occurrence leads its run
+  });
+  t.inverse.resize(np_);
+  const uint8_t* prev = nullptr;
+  int uid = -1;
+  for (int oi = 0; oi < np_; oi++) {
+    const uint8_t* kk = keys.data() + (size_t)order[oi] * kl;
+    if (prev == nullptr || memcmp(prev, kk, (size_t)kl) != 0) {
+      uid++;
+      t.first.push_back(order[oi]);
+      t.keys.insert(t.keys.end(), kk, kk + kl);
+      prev = kk;
+    }
+    t.inverse[order[oi]] = uid;
+  }
+  plan->tiers.push_back(std::move(t));
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cko_plan_new(void* h, const uint8_t* blob, size_t len, int n_req,
+                   const long long* bounds_in, int n_bounds, int min_tier_rows,
+                   const long long* kind_lut, int lut_len, int max_parts,
+                   int min_part_rows, int min_len) {
+  Ctx* ctx = (Ctx*)h;
+  Result* res = (Result*)cko_tensorize(h, blob, len, n_req);
+  if (!res) return nullptr;
+  auto plan = std::make_unique<Plan>();
+  plan->res = res;
+  plan->n_req_b = (int)plan_bucket(std::max(1, n_req));
+  plan->h = std::max<int>(1, (int)ctx->pipelines.size());
+
+  const int n_rows = (int)res->rows.size();
+  std::vector<int32_t> real;
+  if (n_rows == 0) {
+    // tier_tensors keeps one padding row when no real rows exist: all-zero
+    // value, kinds 0, req_id = the pad bucket.
+    plan->empty = true;
+    plan->synth.req = plan->n_req_b;
+    plan->synth.kinds[0] = plan->synth.kinds[1] = plan->synth.kinds[2] = 0;
+    real.push_back(0);
+  } else {
+    real.resize(n_rows);
+    for (int i = 0; i < n_rows; i++) real[i] = i;
+  }
+
+  const int cap = (int)plan_bucket(
+      std::max<long long>(min_len, (long long)res->max_len));
+  std::vector<int> bounds;
+  for (int i = 0; i < n_bounds; i++)
+    if (bounds_in[i] < cap) bounds.push_back((int)bounds_in[i]);
+  bounds.push_back(cap);
+
+  std::vector<int> row_max(real.size());
+  for (size_t i = 0; i < real.size(); i++) {
+    const Row& rw = plan->row(real[i]);
+    size_t rm = rw.value.size();
+    for (const bytes& v : rw.variants) rm = std::max(rm, v.size());
+    row_max[i] = (int)rm;
+  }
+
+  // First-fit bound assignment, row order preserved within each tier.
+  struct RawTier {
+    int b;
+    std::vector<int32_t> sel;
+  };
+  std::vector<RawTier> raw;
+  {
+    std::vector<int32_t> remaining(real.size());
+    for (size_t i = 0; i < real.size(); i++) remaining[i] = (int32_t)i;
+    for (int b : bounds) {
+      std::vector<int32_t> fit, rest;
+      for (int32_t i : remaining)
+        (row_max[i] <= b ? fit : rest).push_back(i);
+      remaining.swap(rest);
+      if (!fit.empty()) {
+        RawTier rt;
+        rt.b = b;
+        rt.sel.reserve(fit.size());
+        for (int32_t i : fit) rt.sel.push_back(real[i]);
+        raw.push_back(std::move(rt));
+      }
+    }
+  }
+
+  // Forward merge (absorb sub-minimum tiers into the next wider bound),
+  // then backward merge of a trailing sub-minimum tier at the wider width.
+  std::vector<RawTier> merged;
+  for (size_t i = 0; i < raw.size(); i++) {
+    RawTier cur = std::move(raw[i]);
+    while ((int)cur.sel.size() < min_tier_rows && i + 1 < raw.size()) {
+      i++;
+      cur.b = raw[i].b;
+      cur.sel.insert(cur.sel.end(), raw[i].sel.begin(), raw[i].sel.end());
+    }
+    merged.push_back(std::move(cur));
+  }
+  if (merged.size() > 1 &&
+      (int)merged.back().sel.size() < min_tier_rows) {
+    RawTier last = std::move(merged.back());
+    merged.pop_back();
+    merged.back().b = std::max(merged.back().b, last.b);
+    merged.back().sel.insert(merged.back().sel.end(), last.sel.begin(),
+                             last.sel.end());
+  }
+
+  for (RawTier& mt : merged) {
+    const int length = (int)plan_bucket(std::max(min_len, mt.b));
+    if (kind_lut == nullptr || max_parts <= 1) {
+      plan_emit(plan.get(), mt.sel, length, false, 0);
+      continue;
+    }
+    // Kind partitioning: rows grouped by the OR of their kinds' class
+    // masks; ascending-mask groups, stable sort by descending size, then
+    // sub-minimum partitions merge into the largest (union mask).
+    auto lut_at = [&](int32_t k) -> long long {
+      return (k >= 0 && k < lut_len) ? kind_lut[k] : 0;
+    };
+    std::map<long long, std::vector<int32_t>> by_mask;
+    for (int32_t ri : mt.sel) {
+      const Row& rw = plan->row(ri);
+      long long pm =
+          lut_at(rw.kinds[0]) | lut_at(rw.kinds[1]) | lut_at(rw.kinds[2]);
+      by_mask[pm].push_back(ri);
+    }
+    struct Part {
+      std::vector<int32_t> sel;
+      long long mask;
+    };
+    std::vector<Part> parts;
+    for (auto& kv : by_mask)
+      parts.push_back(Part{std::move(kv.second), kv.first});
+    std::stable_sort(parts.begin(), parts.end(),
+                     [](const Part& a, const Part& b) {
+                       return a.sel.size() > b.sel.size();
+                     });
+    while (parts.size() > 1 &&
+           (int)parts.back().sel.size() < min_part_rows) {
+      Part small = std::move(parts.back());
+      parts.pop_back();
+      parts[0].sel.insert(parts[0].sel.end(), small.sel.begin(),
+                          small.sel.end());
+      parts[0].mask |= small.mask;
+    }
+    if (parts.size() == 1) {
+      // Single partition: scan-everything trace over the ORIGINAL tier
+      // order (a content-dependent mask would mint executables per mix).
+      plan_emit(plan.get(), mt.sel, length, false, 0);
+    } else {
+      for (Part& p : parts) plan_emit(plan.get(), p.sel, length, true, p.mask);
+    }
+  }
+  return plan.release();
+}
+
+int cko_plan_ntiers(void* h) { return (int)((Plan*)h)->tiers.size(); }
+
+// Per tier: length, n_pairs, n_unique, key_len, has_mask, mask (6 slots).
+int cko_plan_tiers(void* h, long long* out) {
+  Plan* plan = (Plan*)h;
+  for (size_t i = 0; i < plan->tiers.size(); i++) {
+    const PlanTier& t = plan->tiers[i];
+    out[i * 6 + 0] = t.length;
+    out[i * 6 + 1] = (long long)t.sel.size();
+    out[i * 6 + 2] = (long long)t.first.size();
+    out[i * 6 + 3] = t.key_len;
+    out[i * 6 + 4] = t.has_mask ? 1 : 0;
+    out[i * 6 + 5] = t.mask;
+  }
+  return 0;
+}
+
+// Copy one tier's sorted-unique dedup keys (n_unique * key_len bytes) out
+// for the Python value-cache probe.
+int cko_plan_keys(void* h, int ti, uint8_t* out) {
+  Plan* plan = (Plan*)h;
+  if (ti < 0 || ti >= (int)plan->tiers.size()) return -1;
+  const PlanTier& t = plan->tiers[ti];
+  memcpy(out, t.keys.data(), t.keys.size());
+  return 0;
+}
+
+// Scatter every tier into caller-owned staging buffers in one call.
+//
+//   ptrs: 9 buffer addresses per tier — data, lengths, k1, k2, k3, req_id,
+//         vdata, vlengths, uid (the tier-tuple order _tier_specs consumes).
+//   dims: 4 per tier — U (unique-row bucket), P (pair-row bucket), u_pad
+//         (found-row uid base = bucketed miss count), n_miss.
+//   miss_all/miss_off: per-tier ascending unique indexes that MISSED the
+//         value cache (concatenated + offsets). NULL miss_all = no cache:
+//         every unique row exports and uid = inverse.
+//
+// Buffers may be dirty (staging-arena reuse): real rows are written with
+// their padding tails memset'd, and only the pad regions beyond them are
+// zeroed — never the full buffer. Pad req_id rows get n_req_pad.
+int cko_plan_export(void* h, const unsigned long long* ptrs,
+                    const long long* dims, const int32_t* miss_all,
+                    const long long* miss_off, int32_t* numvals, int B, int NV,
+                    int n_req_pad) {
+  Plan* plan = (Plan*)h;
+  const int H = plan->h;
+  for (size_t ti = 0; ti < plan->tiers.size(); ti++) {
+    const PlanTier& t = plan->tiers[ti];
+    const int L = t.length;
+    const int n_u = (int)t.first.size();
+    const int n_p = (int)t.sel.size();
+    const bool identity = miss_all == nullptr;
+    const long long U = dims[ti * 4 + 0];
+    const long long P = dims[ti * 4 + 1];
+    const long long u_pad = dims[ti * 4 + 2];
+    const int n_miss = identity ? n_u : (int)dims[ti * 4 + 3];
+    if (n_miss > U || n_p > P) return -1;
+    const int32_t* miss = identity ? nullptr : miss_all + miss_off[ti];
+
+    // unique j -> exported uid (miss rows first, found rows above u_pad in
+    // ascending-j order — sorted(found.items()) parity).
+    std::vector<int32_t> remap;
+    if (!identity) {
+      remap.assign(n_u, 0);
+      std::vector<uint8_t> is_miss(n_u, 0);
+      for (int r = 0; r < n_miss; r++) {
+        if (miss[r] < 0 || miss[r] >= n_u) return -2;
+        remap[miss[r]] = r;
+        is_miss[miss[r]] = 1;
+      }
+      int fr = 0;
+      for (int j = 0; j < n_u; j++)
+        if (!is_miss[j]) remap[j] = (int32_t)(u_pad + fr++);
+    }
+
+    uint8_t* d = (uint8_t*)ptrs[ti * 9 + 0];
+    int32_t* lg = (int32_t*)ptrs[ti * 9 + 1];
+    int32_t* k1 = (int32_t*)ptrs[ti * 9 + 2];
+    int32_t* k2 = (int32_t*)ptrs[ti * 9 + 3];
+    int32_t* k3 = (int32_t*)ptrs[ti * 9 + 4];
+    int32_t* rid = (int32_t*)ptrs[ti * 9 + 5];
+    uint8_t* vd = (uint8_t*)ptrs[ti * 9 + 6];
+    int32_t* vl = (int32_t*)ptrs[ti * 9 + 7];
+    int32_t* uidp = (int32_t*)ptrs[ti * 9 + 8];
+
+    for (int r = 0; r < n_miss; r++) {
+      const int j = identity ? r : miss[r];
+      const Row& rw = plan->row(t.sel[t.first[j]]);
+      const size_t vlen = rw.value.size();
+      memcpy(d + (size_t)r * L, rw.value.data(), vlen);
+      memset(d + (size_t)r * L + vlen, 0, (size_t)L - vlen);
+      lg[r] = (int32_t)vlen;
+      for (int hi = 0; hi < H; hi++) {
+        const bytes* v =
+            hi < (int)rw.variants.size() ? &rw.variants[hi] : nullptr;
+        const size_t hl = v ? v->size() : 0;
+        uint8_t* dst = vd + ((size_t)hi * U + r) * L;
+        if (hl) memcpy(dst, v->data(), hl);
+        memset(dst + hl, 0, (size_t)L - hl);
+        vl[(size_t)hi * U + r] = (int32_t)hl;
+      }
+    }
+    if (n_miss < U) {
+      memset(d + (size_t)n_miss * L, 0, (size_t)(U - n_miss) * L);
+      memset(lg + n_miss, 0, sizeof(int32_t) * (size_t)(U - n_miss));
+      for (int hi = 0; hi < H; hi++) {
+        memset(vd + ((size_t)hi * U + n_miss) * L, 0,
+               (size_t)(U - n_miss) * L);
+        memset(vl + (size_t)hi * U + n_miss, 0,
+               sizeof(int32_t) * (size_t)(U - n_miss));
+      }
+    }
+    for (int r = 0; r < n_p; r++) {
+      const Row& rw = plan->row(t.sel[r]);
+      k1[r] = rw.kinds[0];
+      k2[r] = rw.kinds[1];
+      k3[r] = rw.kinds[2];
+      rid[r] = rw.req;
+      uidp[r] = identity ? t.inverse[r] : remap[t.inverse[r]];
+    }
+    if (n_p < P) {
+      memset(k1 + n_p, 0, sizeof(int32_t) * (size_t)(P - n_p));
+      memset(k2 + n_p, 0, sizeof(int32_t) * (size_t)(P - n_p));
+      memset(k3 + n_p, 0, sizeof(int32_t) * (size_t)(P - n_p));
+      memset(uidp + n_p, 0, sizeof(int32_t) * (size_t)(P - n_p));
+      for (long long r = n_p; r < P; r++) rid[r] = n_req_pad;
+    }
+  }
+  memset(numvals, 0, sizeof(int32_t) * (size_t)B * NV);
+  const Result* res = plan->res;
+  for (size_t req = 0; req < res->numvals.size() && (int)req < B; req++) {
+    const auto& nv = res->numvals[req];
+    for (size_t vi = 0; vi < nv.size() && (int)vi < NV; vi++)
+      numvals[req * NV + vi] = nv[vi];
+  }
+  return 0;
+}
+
+void cko_plan_free(void* h) { delete (Plan*)h; }
 
 }  // extern "C"

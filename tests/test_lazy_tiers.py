@@ -136,7 +136,9 @@ def test_compile_order_is_smallest_first(monkeypatch):
     reqs += [
         HttpRequest(uri=f"/?b={i}-" + "A" * 700) for i in range(300)
     ]
-    tiers, numvals, _masks, cached, _mk = eng._batch_tensors(reqs)
+    tiers, numvals, _masks, cached, _mk, lease = eng._batch_tensors(reqs)
+    if lease is not None:
+        lease.release()  # only shapes are read below; no dispatch
     match_specs, post_spec, _pairs = eng._tier_specs(
         tiers, numvals, cached=cached
     )
