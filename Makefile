@@ -138,16 +138,17 @@ typecheck:  ## mypy gate over seclang/compiler/engine/analysis (config: pyprojec
 
 # The static-analysis gate (docs/ANALYSIS.md): rulelint over the bundled
 # corpora (zero error-severity findings required) + jaxlint over our own
-# package (any finding fails). Same entrypoint the `analysis` CI job runs.
+# package (any finding fails) + nativelint over the ctypes/C++ boundary.
+# Same entrypoint the `analysis` CI job runs.
 .PHONY: analyze
-analyze:  ## Ruleset static analysis + JAX hot-path self-lint.
+analyze:  ## Ruleset static analysis + JAX hot-path self-lint + native ABI lint.
 	$(PYTHON) -m coraza_kubernetes_operator_tpu.cmd.analyze \
-		ftw/rules ftw/rules/crs-lite --jaxlint
+		ftw/rules ftw/rules/crs-lite --jaxlint --native
 
 .PHONY: analyze.json
 analyze.json:  ## Same gate, machine-readable (CI uploads this as an artifact).
 	@$(PYTHON) -m coraza_kubernetes_operator_tpu.cmd.analyze \
-		ftw/rules ftw/rules/crs-lite --jaxlint --json
+		ftw/rules ftw/rules/crs-lite --jaxlint --native --json
 
 # -- conformance (ftw) --------------------------------------------------------
 
@@ -202,6 +203,11 @@ helm.lint:
 .PHONY: native
 native:  ## Build the C++ host runtime (request tensorizer).
 	$(MAKE) -C native
+
+.PHONY: native.sanitize
+native.sanitize:  ## ASan/UBSan gate: parity corpus + seeded blob-bounds fuzz under sanitizers, bit-identical digests vs the regular build.
+	$(MAKE) -C native all asan
+	$(PYTHON) hack/native_sanitize_smoke.py
 
 .PHONY: help
 help:

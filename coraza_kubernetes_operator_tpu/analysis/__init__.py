@@ -1,6 +1,6 @@
 """Static analysis over rulesets and over this package itself.
 
-Two prongs (docs/ANALYSIS.md):
+Three prongs (docs/ANALYSIS.md):
 
 - ``rulelint``: semantic analysis of a Seclang document against the
   compiled IR (AST + ``CompileReport`` + NFA/DFA tables) — ReDoS risk on
@@ -9,11 +9,16 @@ Two prongs (docs/ANALYSIS.md):
   turns the compiler's skip log into one enforced number.
 - ``jaxlint``: an AST linter over our own source flagging JAX hot-path
   hazards (host syncs under jit, tracer branching, wall-clock reads under
-  trace, lock-order inversions in the sidecar threads).
+  trace, whole-package lock-order inversions, GIL-release buffer safety,
+  ArenaLease lifetimes).
+- ``nativelint``: the Python↔C++ boundary contract — the ctypes ``_ABI``
+  spec in ``native/__init__.py`` cross-checked against the ``extern "C"``
+  exports in ``native/src/cko_native.cpp`` (arity, type widths, restype,
+  buffer-vs-c_char_p, orphan symbols, negative-rc conventions).
 
-Both run in CI (``make analyze``), at RuleSet admission (the ``Analyzed``
-condition), and at sidecar hot reload (new error-severity findings refuse
-the swap unless ``CKO_ANALYZE_OVERRIDE=1``).
+All run in CI (``make analyze``), rulelint additionally at RuleSet
+admission (the ``Analyzed`` condition) and at sidecar hot reload (new
+error-severity findings refuse the swap unless ``CKO_ANALYZE_OVERRIDE=1``).
 """
 
 from .findings import (  # noqa: F401
@@ -22,6 +27,11 @@ from .findings import (  # noqa: F401
     SEV_WARN,
     AnalysisReport,
     Finding,
+)
+from .nativelint import (  # noqa: F401
+    lint_boundary,
+    lint_native,
+    lint_sources,
 )
 from .rulelint import (  # noqa: F401
     analyze_compiled,

@@ -130,14 +130,22 @@ class AnalysisReport:
         )
         if self.coverage:
             cov = self.coverage
-            lines.append(
-                "-- tpu coverage: "
-                f"{cov.get('device_rules', 0)}/{cov.get('total_rules', 0)} rules on-device "
-                f"({cov.get('coverage_pct', 0.0):.1f}%), "
-                f"{cov.get('skipped_rules', 0)} skipped, "
-                f"{cov.get('approximated_rules', 0)} approximated, "
-                f"{cov.get('const_eliminated', 0)} const-eliminated"
-            )
+            if "total_rules" in cov:
+                lines.append(
+                    "-- tpu coverage: "
+                    f"{cov.get('device_rules', 0)}/{cov.get('total_rules', 0)} rules on-device "
+                    f"({cov.get('coverage_pct', 0.0):.1f}%), "
+                    f"{cov.get('skipped_rules', 0)} skipped, "
+                    f"{cov.get('approximated_rules', 0)} approximated, "
+                    f"{cov.get('const_eliminated', 0)} const-eliminated"
+                )
+            else:
+                # Non-rulelint reports (e.g. nativelint) carry their own
+                # coverage shape — render it generically.
+                lines.append(
+                    "-- coverage: "
+                    + ", ".join(f"{k}={cov[k]}" for k in sorted(cov))
+                )
         return "\n".join(lines)
 
     def dumps(self, indent: int | None = None) -> str:

@@ -1,17 +1,20 @@
-"""``cko-analyze`` CLI: ruleset static analysis + JAX self-lint.
+"""``cko-analyze`` CLI: ruleset static analysis + JAX self-lint +
+native-boundary ABI lint.
 
 Usage::
 
     python -m coraza_kubernetes_operator_tpu.cmd.analyze <rules...> \
-        [--json] [--jaxlint] [--fail-on {error,warn,never}]
+        [--json] [--jaxlint] [--native] [--fail-on {error,warn,never}]
 
 Each positional argument is one Seclang document: a ``.conf`` file, a
 CRS-layout directory (loaded setup-first via ``ftw.corpus``), or ``-``
 for stdin. ``--jaxlint`` additionally (or, with no rules given, only)
-lints this package's own source for JAX hot-path hazards. Exit status is
-0 when no finding at or above ``--fail-on`` severity exists, 1 otherwise
-— the contract the ``analysis`` CI job and the sidecar reload gate build
-on (docs/ANALYSIS.md).
+lints this package's own source for JAX hot-path hazards; ``--native``
+cross-checks the ctypes ``_ABI`` spec against the ``extern "C"`` exports
+in ``native/src/cko_native.cpp`` (analysis/nativelint.py). Exit status
+is 0 when no finding at or above ``--fail-on`` severity exists, 1
+otherwise — the contract the ``analysis`` CI job and the sidecar reload
+gate build on (docs/ANALYSIS.md).
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ from pathlib import Path
 
 from ..analysis import SEV_ERROR, SEV_WARN, analyze_ruleset
 from ..analysis.jaxlint import lint_package
+from ..analysis.nativelint import lint_native
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--jaxlint",
         action="store_true",
         help="also lint this package's source for JAX hot-path hazards",
+    )
+    p.add_argument(
+        "--native",
+        action="store_true",
+        help="also cross-check the ctypes ABI spec against the C++ exports",
     )
     p.add_argument(
         "--fail-on",
@@ -72,8 +81,10 @@ def _failed(counts: dict, fail_on: str) -> bool:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if not args.rules and not args.jaxlint:
-        build_parser().error("give at least one rules document or --jaxlint")
+    if not args.rules and not args.jaxlint and not args.native:
+        build_parser().error(
+            "give at least one rules document, --jaxlint, or --native"
+        )
 
     out: dict[str, dict] = {}
     failed = False
@@ -91,6 +102,13 @@ def main(argv: list[str] | None = None) -> int:
         failed = failed or _failed(report.counts(), args.fail_on)
         if not args.json:
             print("== jaxlint coraza_kubernetes_operator_tpu/")
+            print(report.render())
+    if args.native:
+        report = lint_native()
+        out["<nativelint>"] = report.to_json()
+        failed = failed or _failed(report.counts(), args.fail_on)
+        if not args.json:
+            print("== nativelint native/src/cko_native.cpp <-> native/_ABI")
             print(report.render())
     if args.json:
         print(json.dumps(out, indent=2, sort_keys=True))

@@ -70,6 +70,142 @@ _NUMERIC_ORDER = [
 
 
 
+# ---------------------------------------------------------------------------
+# ABI contract — the single source of truth for the Python↔C++ boundary.
+# ---------------------------------------------------------------------------
+# One entry per exported symbol in native/src/cko_native.cpp. Two
+# consumers read THIS table, so a binding edit cannot drift from the
+# check:
+#   * ``load_library()`` materializes ctypes argtypes/restype from it;
+#   * ``analysis/nativelint.py`` literal-parses it (the table must stay a
+#     pure literal — no computed values) and cross-checks every entry
+#     against the ``extern "C"`` declarators in the C++ source
+#     (docs/ANALYSIS.md "Native boundary", findings CKO-N001..N008).
+#
+# Symbolic tokens (resolved via _CTYPES):
+#   ptr    opaque handle / void*                  -> c_void_p
+#   buf    byte buffer that may arrive as a bytearray or any other
+#          buffer-protocol object (routed through _buf_arg) -> c_void_p.
+#          NEVER c_char_p: ctypes rejects a bytearray for c_char_p with
+#          an ArgumentError — the silent-fallback bug class that demoted
+#          every blob_over_limit window to the host path (CKO-N004).
+#   arr    numpy array data pointer (input or output) -> c_void_p
+#   i32p   POINTER(c_int32) out-array
+#   size   size_t -> c_size_t;  int -> c_int;  u32 -> c_uint32
+#
+# Per-entry flags:
+#   "ret":      return token (omit/None for void). Pointer-returning
+#               exports MUST set a pointer token — ctypes defaults to C
+#               int and truncates 64-bit handles (CKO-N003).
+#   "rc":       returns 0 on success / NEGATIVE error codes; the restype
+#               must stay signed c_int or the sentinel inverts (CKO-N007).
+#   "optional": symbol tolerated missing in an older .so.
+#   "group":    all-or-nothing feature set; "plan" gates the tiered
+#               window pipeline (lib._cko_has_plan).
+_ABI: dict = {
+    "cko_ctx_new": {"args": ["buf", "size"], "ret": "ptr"},
+    "cko_ctx_free": {"args": ["ptr"], "ret": None},
+    "cko_sqli": {"args": ["ptr", "buf", "size"], "ret": "int"},
+    "cko_xss": {"args": ["ptr", "buf", "size"], "ret": "int"},
+    "cko_tensorize": {"args": ["ptr", "buf", "size", "int"], "ret": "ptr"},
+    "cko_result_rows": {"args": ["ptr"], "ret": "int"},
+    "cko_result_maxlen": {"args": ["ptr"], "ret": "int"},
+    "cko_result_export": {
+        # res + 9 output planes (data, lengths, k1, k2, k3, req_id,
+        # vdata, vlengths, numvals) + T, L, H, B, NV, n_req_pad.
+        "args": [
+            "ptr",
+            "arr", "arr", "arr", "arr", "arr", "arr", "arr", "arr", "arr",
+            "int", "int", "int", "int", "int", "int",
+        ],
+        "ret": "int",
+        "rc": True,
+    },
+    "cko_result_free": {"args": ["ptr"], "ret": None},
+    "cko_json_to_blob": {"args": ["buf", "size"], "ret": "ptr"},
+    "cko_blob_data": {"args": ["ptr"], "ret": "ptr"},
+    "cko_blob_len": {"args": ["ptr"], "ret": "size"},
+    "cko_blob_nreq": {"args": ["ptr"], "ret": "int"},
+    "cko_blob_free": {"args": ["ptr"], "ret": None},
+    "cko_blob_overlimit": {
+        "args": ["buf", "size", "u32", "i32p", "int"],
+        "ret": "int",
+        "optional": True,
+    },
+    # Window-plan ABI (tiered export): blob -> tier-bucketed plan in one
+    # GIL-released call, then one export call scattering every tier into
+    # the staging arena. Older .so -> NativeTensorizer.tiered is False
+    # and the per-window _export path serves.
+    "cko_plan_new": {
+        # ctx, blob, len, n_req, tier bounds (int64[]), n_bounds,
+        # min_tier_rows, kind lut (int64[]) or NULL, lut_len, max_parts,
+        # min_part_rows, min_len.
+        "args": [
+            "ptr", "buf", "size", "int", "arr", "int", "int", "arr",
+            "int", "int", "int", "int",
+        ],
+        "ret": "ptr",
+        "group": "plan",
+    },
+    "cko_plan_ntiers": {"args": ["ptr"], "ret": "int", "group": "plan"},
+    "cko_plan_tiers": {"args": ["ptr", "arr"], "ret": "int", "group": "plan"},
+    "cko_plan_keys": {
+        "args": ["ptr", "int", "arr"],
+        "ret": "int",
+        "rc": True,
+        "group": "plan",
+    },
+    "cko_plan_export": {
+        # plan, ptrs (uint64[9*n_tiers]), dims (int64[4*n_tiers]),
+        # miss_all (int32[]) or NULL, miss_off (int64[]) or NULL,
+        # numvals, B, NV, n_req_pad.
+        "args": [
+            "ptr", "arr", "arr", "arr", "arr", "arr", "int", "int", "int",
+        ],
+        "ret": "int",
+        "rc": True,
+        "group": "plan",
+    },
+    "cko_plan_free": {"args": ["ptr"], "ret": None, "group": "plan"},
+}
+
+# Token -> ctypes type. nativelint cross-checks the TOKEN against the C
+# declarator's width/class; this mapping is the one place a token gains
+# a concrete ctypes meaning.
+_CTYPES: dict = {
+    "ptr": ctypes.c_void_p,
+    "buf": ctypes.c_void_p,
+    "arr": ctypes.c_void_p,
+    "charp": ctypes.c_char_p,
+    "i32p": ctypes.POINTER(ctypes.c_int32),
+    "size": ctypes.c_size_t,
+    "int": ctypes.c_int,
+    "u32": ctypes.c_uint32,
+}
+
+
+def _bind(lib) -> None:
+    """Apply the ``_ABI`` spec to a freshly loaded CDLL: argtypes and
+    restype for every exported symbol, optional symbols tolerated,
+    feature groups all-or-nothing (a partial plan ABI never half-loads)."""
+    missing_groups: set[str] = set()
+    for name, spec in _ABI.items():
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            group = spec.get("group")
+            if group is not None:
+                missing_groups.add(group)
+                continue
+            if spec.get("optional"):
+                continue
+            raise
+        fn.argtypes = [_CTYPES[t] for t in spec["args"]]
+        ret = spec.get("ret")
+        fn.restype = _CTYPES[ret] if ret is not None else None
+    lib._cko_has_plan = "plan" not in missing_groups
+
+
 def _lib_path() -> Path | None:
     env = os.environ.get("CKO_NATIVE_LIB")
     if env:
@@ -92,90 +228,7 @@ def load_library():
     if path is None:
         return None
     lib = ctypes.CDLL(str(path))
-    lib.cko_ctx_new.restype = ctypes.c_void_p
-    lib.cko_ctx_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-    lib.cko_ctx_free.argtypes = [ctypes.c_void_p]
-    lib.cko_tensorize.restype = ctypes.c_void_p
-    # Blob parameters are c_void_p, not c_char_p: ctypes passes bytes AND
-    # buffer-protocol wrappers (from_buffer over the ingest frontend's
-    # bytearray) to a void* without copying — see _buf_arg.
-    lib.cko_tensorize.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int
-    ]
-    lib.cko_result_rows.argtypes = [ctypes.c_void_p]
-    lib.cko_result_maxlen.argtypes = [ctypes.c_void_p]
-    lib.cko_result_export.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 9 + [
-        ctypes.c_int
-    ] * 6
-    lib.cko_result_free.argtypes = [ctypes.c_void_p]
-    lib.cko_sqli.restype = ctypes.c_int
-    lib.cko_sqli.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
-    lib.cko_xss.restype = ctypes.c_int
-    lib.cko_xss.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
-    lib.cko_json_to_blob.restype = ctypes.c_void_p
-    lib.cko_json_to_blob.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-    lib.cko_blob_data.restype = ctypes.c_void_p
-    lib.cko_blob_data.argtypes = [ctypes.c_void_p]
-    lib.cko_blob_len.restype = ctypes.c_size_t
-    lib.cko_blob_len.argtypes = [ctypes.c_void_p]
-    lib.cko_blob_nreq.restype = ctypes.c_int
-    lib.cko_blob_nreq.argtypes = [ctypes.c_void_p]
-    lib.cko_blob_free.argtypes = [ctypes.c_void_p]
-    try:
-        lib.cko_blob_overlimit.restype = ctypes.c_int
-        lib.cko_blob_overlimit.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-            ctypes.c_uint32,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int,
-        ]
-    except AttributeError:
-        pass  # older .so without the scanner; blob_over_limit walks in Python
-    try:
-        # Window-plan ABI (tiered export): blob -> tier-bucketed plan in one
-        # GIL-released call, then one export call scattering every tier into
-        # the staging arena. Older .so -> NativeTensorizer.tiered is False
-        # and the per-window _export path serves.
-        lib.cko_plan_new.restype = ctypes.c_void_p
-        lib.cko_plan_new.argtypes = [
-            ctypes.c_void_p,  # ctx
-            ctypes.c_void_p,  # blob
-            ctypes.c_size_t,  # len
-            ctypes.c_int,     # n_req
-            ctypes.c_void_p,  # tier bounds (int64[])
-            ctypes.c_int,     # n_bounds
-            ctypes.c_int,     # min_tier_rows
-            ctypes.c_void_p,  # kind lut (int64[]) or NULL
-            ctypes.c_int,     # lut_len
-            ctypes.c_int,     # max_parts
-            ctypes.c_int,     # min_part_rows
-            ctypes.c_int,     # min_len
-        ]
-        lib.cko_plan_ntiers.restype = ctypes.c_int
-        lib.cko_plan_ntiers.argtypes = [ctypes.c_void_p]
-        lib.cko_plan_tiers.restype = ctypes.c_int
-        lib.cko_plan_tiers.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.cko_plan_keys.restype = ctypes.c_int
-        lib.cko_plan_keys.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p
-        ]
-        lib.cko_plan_export.restype = ctypes.c_int
-        lib.cko_plan_export.argtypes = [
-            ctypes.c_void_p,  # plan
-            ctypes.c_void_p,  # ptrs (uint64[9 * n_tiers])
-            ctypes.c_void_p,  # dims (int64[4 * n_tiers])
-            ctypes.c_void_p,  # miss_all (int32[]) or NULL
-            ctypes.c_void_p,  # miss_off (int64[]) or NULL
-            ctypes.c_void_p,  # numvals
-            ctypes.c_int,     # B
-            ctypes.c_int,     # NV
-            ctypes.c_int,     # n_req_pad
-        ]
-        lib.cko_plan_free.argtypes = [ctypes.c_void_p]
-        lib._cko_has_plan = True
-    except AttributeError:
-        lib._cko_has_plan = False
+    _bind(lib)
     _lib = lib
     return _lib
 
@@ -428,7 +481,7 @@ class NativeTensorizer:
         request blob lets the caller recover (method, uri, version,
         remote) for audit records without re-parsing the JSON."""
         assert self._ctx is not None
-        h = self._lib.cko_json_to_blob(body, len(body))
+        h = self._lib.cko_json_to_blob(_buf_arg(body), len(body))
         if not h:
             return None
         try:

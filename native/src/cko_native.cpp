@@ -2066,6 +2066,13 @@ void* cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
     bytes uri = r.str();
     bytes version = r.str();
     uint32_t n_headers = r.u32();
+    // A lying header count would demand the allocation below before any
+    // per-header read could fail; every header needs >= 8 blob bytes
+    // (two length prefixes), so counts past that are corrupt framing.
+    if (!r.ok || (size_t)n_headers > (size_t)(r.end - r.p) / 8) {
+      r.ok = false;
+      break;
+    }
     std::vector<std::pair<bytes, bytes>> headers(n_headers);
     for (uint32_t hi = 0; hi < n_headers && r.ok; hi++) {
       headers[hi].first = r.str();

@@ -257,6 +257,209 @@ def test_interprocedural_inversion_flagged():
     assert "CKO-J005" in _codes(src, rel="sidecar/fixture.py")
 
 
+def test_cross_module_lock_inversion_flagged(tmp_path):
+    """J005 is whole-package: the cycle spans two modules through typed
+    self-attribute calls (`self._quarantine.push()` resolving to the
+    Quarantine class in the other file)."""
+    from coraza_kubernetes_operator_tpu.analysis.jaxlint import lint_paths
+
+    (tmp_path / "a.py").write_text(textwrap.dedent(
+        """
+        from threading import Lock
+        from b import Quarantine
+
+        class Sched:
+            def __init__(self):
+                self._sched_lock = Lock()
+                self._quarantine = Quarantine(self)
+
+            def tick(self):
+                with self._sched_lock:
+                    self._quarantine.push()
+        """
+    ))
+    (tmp_path / "b.py").write_text(textwrap.dedent(
+        """
+        from threading import Lock
+        from a import Sched
+
+        class Quarantine:
+            def __init__(self, sched):
+                self._q_lock = Lock()
+                self._sched = Sched()
+
+            def push(self):
+                with self._q_lock:
+                    pass
+
+            def drain(self):
+                with self._q_lock:
+                    self._sched.tick()
+        """
+    ))
+    report = lint_paths([tmp_path], root=tmp_path)
+    assert "CKO-J005" in [f.code for f in report.findings], report.render()
+
+
+# ---------------------------------------------------------------------------
+# CKO-J006: shared buffers across the GIL-released native boundary
+# ---------------------------------------------------------------------------
+
+
+def test_global_bytearray_to_native_call_flagged():
+    src = """
+    SCRATCH = bytearray(1 << 20)
+
+    def tensorize(lib, n):
+        return lib.cko_tensorize(SCRATCH, len(SCRATCH), n)
+    """
+    assert "CKO-J006" in _codes(src)
+
+
+def test_attr_bytearray_to_from_buffer_flagged():
+    # (ctypes.c_ubyte * n).from_buffer(self._scratch): the pointer pin
+    # outlives the statement while other threads can resize the buffer.
+    src = """
+    import ctypes
+
+    class Host:
+        def __init__(self):
+            self._scratch = bytearray(64)
+
+        def pin(self):
+            return (ctypes.c_ubyte * 64).from_buffer(self._scratch)
+    """
+    assert "CKO-J006" in _codes(src)
+
+
+def test_frame_local_bytearray_not_flagged():
+    src = """
+    def tensorize(lib, n):
+        buf = bytearray(1 << 20)
+        return lib.cko_tensorize(buf, len(buf), n)
+    """
+    assert _codes(src) == []
+
+
+def test_shared_bytearray_to_python_call_not_flagged():
+    # Only the GIL-released boundary is unsafe; ordinary Python calls
+    # hold the GIL and cannot race a resize.
+    src = """
+    SCRATCH = bytearray(64)
+
+    def digest():
+        return hash_all(SCRATCH)
+    """
+    assert _codes(src) == []
+
+
+def test_j006_suppression():
+    src = """
+    SCRATCH = bytearray(64)
+
+    def tensorize(lib, n):
+        return lib.cko_tensorize(SCRATCH, 64, n)  # jaxlint: ignore[CKO-J006]
+    """
+    assert _codes(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-J007: ArenaLease lifetimes
+# ---------------------------------------------------------------------------
+
+
+def test_leaked_lease_flagged():
+    src = """
+    def dispatch(self, blob, n):
+        lease = self._arena.checkout()
+        return self._tensorize(blob, n)
+    """
+    assert "CKO-J007" in _codes(src)
+
+
+def test_release_in_finally_not_flagged():
+    src = """
+    def dispatch(self, blob, n):
+        lease = self._arena.checkout()
+        try:
+            return self._tensorize(blob, n)
+        finally:
+            lease.release()
+    """
+    assert _codes(src) == []
+
+
+def test_lease_escaping_by_return_not_flagged():
+    # Ownership rides the batch: collect() releases it later.
+    src = """
+    def dispatch(self, blob, n):
+        lease = self._arena.checkout()
+        tensors = self._tensorize(blob, n)
+        return tensors, lease
+    """
+    assert _codes(src) == []
+
+
+def test_lease_handed_to_batch_not_flagged():
+    src = """
+    def dispatch(self, blob, n):
+        lease = self._arena.checkout()
+        self._inflight.append(lease)
+    """
+    assert _codes(src) == []
+
+
+def test_tuple_unpacked_lease_leak_flagged():
+    # tier_blob returns the lease as one element of its tuple.
+    src = """
+    def tier(self, blob, n):
+        tiers, numvals, lease = self._native.tier_blob(blob, n)
+        return tiers
+    """
+    assert "CKO-J007" in _codes(src)
+
+
+def test_double_release_flagged():
+    src = """
+    def done(self):
+        lease = self._arena.checkout()
+        lease.release()
+        lease.release()
+    """
+    assert "CKO-J007" in _codes(src)
+
+
+def test_use_after_release_flagged():
+    src = """
+    def done(self):
+        lease = self._arena.checkout()
+        lease.release()
+        self._read(lease.view())
+    """
+    assert "CKO-J007" in _codes(src)
+
+
+def test_kubernetes_lease_dict_not_flagged():
+    # A coordination.k8s.io Lease is not an ArenaLease: plain get()
+    # results named "lease" must not trip the lifetime check.
+    src = """
+    def renew(self):
+        lease = self.client.get("Lease", "cko-operator")
+        lease["spec"]["renewTime"] = now()
+        self.client.put(lease)
+    """
+    assert _codes(src) == []
+
+
+def test_j007_suppression_on_checkout_line():
+    src = """
+    def dispatch(self, blob, n):
+        lease = self._arena.checkout()  # jaxlint: ignore[CKO-J007]
+        return self._tensorize(blob, n)
+    """
+    assert _codes(src) == []
+
+
 # ---------------------------------------------------------------------------
 # Suppressions + syntax errors
 # ---------------------------------------------------------------------------

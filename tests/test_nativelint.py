@@ -1,0 +1,331 @@
+"""nativelint (analysis prong 3): every CKO-N class fires on a seeded
+boundary mutation, the declarator parser survives real-source hazards
+(nested extern "C", comments, braces in strings), the real repo boundary
+is clean, and the report is deterministic.
+
+All fixture checks lint SOURCE STRINGS through ``lint_sources`` — no
+compiler, no import of the bindings module — mirroring how the CI gate
+(``cko-analyze --native``) runs (docs/ANALYSIS.md "Native boundary").
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+from coraza_kubernetes_operator_tpu.analysis.findings import (
+    SEV_ERROR,
+    SEV_WARN,
+)
+from coraza_kubernetes_operator_tpu.analysis.nativelint import (
+    lint_native,
+    lint_sources,
+    load_abi,
+    parse_exports,
+)
+
+# A minimal boundary pair that must lint completely clean; every seeded
+# test below mutates exactly one side of it.
+CPP_OK = textwrap.dedent(
+    """
+    #include <stdint.h>
+    #include <stddef.h>
+
+    extern "C" {
+
+    void* cko_ctx_new(const uint8_t* blob, size_t len) {
+      return (void*)(blob + len);
+    }
+
+    int cko_tensorize(void* h, const uint8_t* blob, size_t len, int n_req) {
+      if (!h || !blob || !len) return -1;
+      return n_req;
+    }
+
+    size_t cko_result_maxlen(void* h) { return h ? 8 : 0; }
+
+    void cko_ctx_free(void* h) { (void)h; }
+
+    }  // extern "C"
+    """
+)
+
+ABI_OK = textwrap.dedent(
+    """
+    _ABI = {
+        "cko_ctx_new": {"args": ["buf", "size"], "ret": "ptr"},
+        "cko_tensorize": {
+            "args": ["ptr", "buf", "size", "int"], "ret": "int", "rc": True,
+        },
+        "cko_result_maxlen": {"args": ["ptr"], "ret": "size"},
+        "cko_ctx_free": {"args": ["ptr"]},
+    }
+    """
+)
+
+
+def _findings(cpp: str = CPP_OK, abi: str = ABI_OK):
+    return lint_sources(cpp, abi)
+
+
+def _codes(cpp: str = CPP_OK, abi: str = ABI_OK) -> list[str]:
+    return [f.code for f in _findings(cpp, abi)]
+
+
+def test_baseline_fixture_is_clean():
+    assert _codes() == []
+
+
+# ---------------------------------------------------------------------------
+# CKO-N000: unparseable boundary source
+# ---------------------------------------------------------------------------
+
+
+def test_missing_abi_literal_is_n000():
+    findings = _findings(abi="BINDINGS = None\n")
+    assert [f.code for f in findings] == ["CKO-N000"]
+    assert findings[0].severity == SEV_ERROR
+
+
+def test_computed_abi_is_n000():
+    # A non-literal spec cannot be cross-checked; the linter must say so
+    # rather than silently checking nothing.
+    assert _codes(abi="_ABI = build_abi()\n") == ["CKO-N000"]
+
+
+def test_abi_entry_without_args_list_is_n000():
+    abi = ABI_OK.replace('"args": ["ptr"]},', '"argv": ["ptr"]},')
+    assert "CKO-N000" in _codes(abi=abi)
+
+
+# ---------------------------------------------------------------------------
+# CKO-N001: arity skew
+# ---------------------------------------------------------------------------
+
+
+def test_arity_skew_is_n001():
+    abi = ABI_OK.replace('["ptr", "buf", "size", "int"]', '["ptr", "buf", "size"]')
+    assert "CKO-N001" in _codes(abi=abi)
+
+
+# ---------------------------------------------------------------------------
+# CKO-N002: parameter width/class skew
+# ---------------------------------------------------------------------------
+
+
+def test_pointer_bound_as_int_is_n002_error():
+    abi = ABI_OK.replace('["buf", "size"]', '["int", "size"]')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N002"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+def test_size_t_bound_as_int32_is_n002_error():
+    # The classic LP64 trap: c_int for size_t mismarshals the upper half.
+    abi = ABI_OK.replace('["buf", "size"]', '["buf", "int"]')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N002"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+def test_signedness_skew_is_n002_warn():
+    abi = ABI_OK.replace('"size", "int"], "ret"', '"size", "u32"], "ret"')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N002"]
+    assert f and all(x.severity == SEV_WARN for x in f)
+
+
+def test_unknown_abi_token_is_n002_error():
+    abi = ABI_OK.replace('"cko_ctx_free": {"args": ["ptr"]}',
+                         '"cko_ctx_free": {"args": ["wat"]}')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N002"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+# ---------------------------------------------------------------------------
+# CKO-N003: restype skew
+# ---------------------------------------------------------------------------
+
+
+def test_pointer_return_without_ptr_restype_is_n003():
+    # The bug ctypes invites by default: missing restype -> C int ->
+    # 64-bit handle truncation.
+    abi = ABI_OK.replace('"args": ["buf", "size"], "ret": "ptr"',
+                         '"args": ["buf", "size"]')
+    assert "CKO-N003" in _codes(abi=abi)
+
+
+def test_size_t_return_bound_as_int32_is_n003():
+    abi = ABI_OK.replace('"ret": "size"', '"ret": "int"')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N003"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+def test_void_return_with_restype_is_n003():
+    abi = ABI_OK.replace('"cko_ctx_free": {"args": ["ptr"]}',
+                         '"cko_ctx_free": {"args": ["ptr"], "ret": "int"}')
+    assert "CKO-N003" in _codes(abi=abi)
+
+
+# ---------------------------------------------------------------------------
+# CKO-N004: c_char_p on a (byte-pointer, size_t) buffer parameter
+# ---------------------------------------------------------------------------
+
+
+def test_charp_buffer_binding_is_n004():
+    # The blob_over_limit bug class: c_char_p raises ArgumentError for
+    # bytearray callers and the call site silently falls back.
+    abi = ABI_OK.replace('["buf", "size"], "ret": "ptr"',
+                         '["charp", "size"], "ret": "ptr"')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N004"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+def test_charp_without_length_param_is_not_n004():
+    # A genuine NUL-terminated string parameter (no size_t companion)
+    # is what c_char_p is for.
+    cpp = CPP_OK + '\nextern "C" { int cko_by_name(const char* name) { return 0; } }\n'
+    abi = ABI_OK.rstrip().rstrip("}").rstrip() + (
+        '\n    "cko_by_name": {"args": ["charp"], "ret": "int"},\n}\n'
+    )
+    assert "CKO-N004" not in _codes(cpp=cpp, abi=abi)
+
+
+# ---------------------------------------------------------------------------
+# CKO-N005 / CKO-N006: orphan symbols
+# ---------------------------------------------------------------------------
+
+
+def test_export_without_binding_is_n005_warn():
+    cpp = CPP_OK + '\nextern "C" { int cko_orphan(int x) { return x; } }\n'
+    f = [x for x in _findings(cpp=cpp) if x.code == "CKO-N005"]
+    assert f and f[0].severity == SEV_WARN
+
+
+def test_binding_without_export_is_n006_error():
+    abi = ABI_OK.rstrip().rstrip("}").rstrip() + (
+        '\n    "cko_ghost": {"args": ["ptr"], "ret": "int"},\n}\n'
+    )
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N006"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+# ---------------------------------------------------------------------------
+# CKO-N007: negative-rc convention
+# ---------------------------------------------------------------------------
+
+
+def test_negative_rc_export_without_rc_flag_is_n007_error():
+    abi = ABI_OK.replace(', "rc": True', "")
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N007"]
+    assert f and f[0].severity == SEV_ERROR
+
+
+def test_stale_rc_flag_is_n007_warn():
+    abi = ABI_OK.replace('"ret": "size"', '"ret": "size", "rc": True')
+    f = [x for x in _findings(abi=abi) if x.code == "CKO-N007"]
+    assert f and f[0].severity == SEV_WARN
+
+
+# ---------------------------------------------------------------------------
+# CKO-N008: definition outside extern "C"
+# ---------------------------------------------------------------------------
+
+
+def test_definition_outside_extern_c_is_n008():
+    cpp = CPP_OK + "\nint cko_mangled(int x) { return x ? x : -1; }\n"
+    abi = ABI_OK.rstrip().rstrip("}").rstrip() + (
+        '\n    "cko_mangled": {"args": ["int"], "ret": "int", "rc": True},\n}\n'
+    )
+    assert "CKO-N008" in _codes(cpp=cpp, abi=abi)
+
+
+# ---------------------------------------------------------------------------
+# Declarator parser hazards
+# ---------------------------------------------------------------------------
+
+
+def test_nested_extern_c_blocks_are_in_scope():
+    cpp = textwrap.dedent(
+        """
+        extern "C" {
+        extern "C" {
+        void cko_ctx_free(void* h) { (void)h; }
+        }
+        }
+        """
+    )
+    exp = parse_exports(cpp)
+    assert exp["cko_ctx_free"].in_extern_c
+
+
+def test_declarations_are_not_exports():
+    # Only definitions produce .so symbols; a `;`-terminated prototype
+    # must not satisfy a binding.
+    cpp = 'extern "C" {\nint cko_proto(int x);\n}\n'
+    assert "cko_proto" not in parse_exports(cpp)
+
+
+def test_braces_in_strings_and_comments_do_not_break_parsing():
+    cpp = textwrap.dedent(
+        """
+        extern "C" {
+        // a } brace in a comment { and another
+        int cko_tricky(const char* s) {
+          const char* t = "}{";  /* "{" */
+          if (s == t) return -1;
+          return 0;
+        }
+        }
+        """
+    )
+    exp = parse_exports(cpp)
+    assert exp["cko_tricky"].in_extern_c
+    assert exp["cko_tricky"].returns_negative
+    assert len(exp["cko_tricky"].params) == 1
+
+
+def test_returns_negative_scan():
+    exp = parse_exports(CPP_OK)
+    assert exp["cko_tensorize"].returns_negative
+    assert not exp["cko_ctx_new"].returns_negative
+    assert not exp["cko_result_maxlen"].returns_negative
+
+
+def test_load_abi_never_imports():
+    # A bindings module whose import would explode must still yield its
+    # literal table.
+    src = "import does_not_exist_anywhere\n" + ABI_OK
+    abi = load_abi(src)
+    assert abi is not None and set(abi) == {
+        "cko_ctx_new", "cko_tensorize", "cko_result_maxlen", "cko_ctx_free",
+    }
+
+
+# ---------------------------------------------------------------------------
+# The real repo boundary: clean, non-trivial, deterministic
+# ---------------------------------------------------------------------------
+
+
+def test_repo_boundary_is_clean():
+    report = lint_native()
+    assert report.findings == [], "\n" + report.render()
+
+
+def test_repo_boundary_coverage_is_nontrivial():
+    # A linter that parses nothing is trivially clean: the real tree must
+    # present a checked surface with no orphans on either side.
+    report = lint_native()
+    cov = report.coverage
+    assert cov["exports"] >= 15, cov
+    assert cov["exports"] == cov["bindings"] == cov["checked"], cov
+
+
+def test_report_is_deterministic():
+    a = json.dumps(lint_native().to_json(), sort_keys=True)
+    b = json.dumps(lint_native().to_json(), sort_keys=True)
+    assert a == b
+
+
+def test_missing_files_are_n000(tmp_path):
+    report = lint_native(
+        cpp_path=tmp_path / "nope.cpp", bindings_path=tmp_path / "nope.py"
+    )
+    assert [f.code for f in report.findings] == ["CKO-N000", "CKO-N000"]
